@@ -1,0 +1,21 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch framework with the capabilities of the reference (Pilosa v1.4:
+distributed roaring-bitmap index answering PQL), re-designed for TPU:
+
+- set algebra runs as dense bit-plane kernels in HBM (`pilosa_tpu.ops`),
+- shards map onto a `jax.sharding.Mesh`; cross-shard reduces ride ICI
+  collectives (`pilosa_tpu.parallel`),
+- roaring remains the host-side interchange/at-rest format
+  (`pilosa_tpu.roaring`),
+- the metadata tree (holder/index/field/view/fragment), PQL, executor, HTTP
+  API, and cluster control plane mirror the reference's public capabilities
+  (`pilosa_tpu.core`, `.pql`, `.exec`, `.server`).
+
+Heavy imports (jax) are deferred: importing `pilosa_tpu` alone loads no
+device code.
+"""
+
+__version__ = "0.1.0"
+
+from .shardwidth import SHARD_WIDTH

@@ -1,0 +1,20 @@
+"""Device kernel layer — the TPU-native equivalent of the reference's roaring
+container kernels (reference: roaring/roaring.go:3121-5196)."""
+
+from . import bitplane, bsi
+from .bitplane import (
+    any_set,
+    columns_from_plane,
+    count_intersect,
+    difference,
+    intersect,
+    not_,
+    plane_from_columns,
+    popcount,
+    popcount_rows,
+    shift,
+    topn_counts,
+    union,
+    union_rows,
+    xor,
+)

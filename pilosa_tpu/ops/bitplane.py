@@ -1,0 +1,163 @@
+"""Device kernels over dense row planes.
+
+A "plane" is one row of one shard: a dense bitset of SHARD_WIDTH bits packed
+little-endian into uint32 words (shape [WORDS_PER_ROW]). A "stack" is a batch
+of planes (shape [R, WORDS_PER_ROW]).
+
+These kernels are the TPU-native equivalent of the reference's hand-optimized
+roaring container kernels (reference: roaring/roaring.go:3121-5196 — per
+container-type intersect/union/difference/xor/popcount). Where the reference
+dispatches on container representation (array/bitmap/run), we keep everything
+dense in HBM and let the VPU chew through whole planes; set algebra is
+elementwise and popcounts reduce with `lax.population_count`.
+
+All functions are jitted and shape-polymorphic only through retracing; shapes
+are static per compilation, which is what XLA wants.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..shardwidth import SHARD_WIDTH, WORD_BITS, WORDS_PER_ROW
+
+__all__ = [
+    "intersect",
+    "union",
+    "difference",
+    "xor",
+    "not_",
+    "popcount",
+    "popcount_rows",
+    "count_intersect",
+    "union_rows",
+    "any_set",
+    "shift",
+    "plane_from_columns",
+    "columns_from_plane",
+    "topn_counts",
+]
+
+
+@jax.jit
+def intersect(a, b):
+    return a & b
+
+
+@jax.jit
+def union(a, b):
+    return a | b
+
+
+@jax.jit
+def difference(a, b):
+    return a & ~b
+
+
+@jax.jit
+def xor(a, b):
+    return a ^ b
+
+
+@jax.jit
+def not_(a):
+    """Complement within the shard universe (used with an existence mask by
+    the executor — reference: executor.go executeNot via index._exists)."""
+    return ~a
+
+
+@jax.jit
+def popcount(a):
+    """Number of set bits in a plane. int32 is safe: a plane holds at most
+    SHARD_WIDTH (2^20) bits (reference popcount kernels: roaring.go:5291)."""
+    return jnp.sum(jax.lax.population_count(a).astype(jnp.int32))
+
+
+@jax.jit
+def popcount_rows(stack):
+    """Per-row popcount over a stack [R, W] -> [R] int32."""
+    return jnp.sum(jax.lax.population_count(stack).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def count_intersect(a, b):
+    """Fused intersection-count — the north-star hot loop (reference:
+    intersectionCount* kernels roaring.go:3121-3480). XLA fuses the AND into
+    the popcount reduce; no intermediate plane is materialized."""
+    return jnp.sum(jax.lax.population_count(a & b).astype(jnp.int32))
+
+
+@jax.jit
+def union_rows(stack):
+    """OR-reduce a stack [R, W] -> [W] (used by ClearRow/Store fan-ins and
+    time-quantum view unions, reference: view union paths)."""
+    return jax.lax.reduce(
+        stack,
+        jnp.uint32(0),
+        jax.lax.bitwise_or,
+        dimensions=[0],
+    )
+
+
+@jax.jit
+def any_set(a):
+    """True iff any bit is set (reference: Row.Any / Bitmap.Any)."""
+    return jnp.any(a != 0)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _shift_static(a, n):
+    """Shift the whole plane toward higher column ids by n bits (reference:
+    Row.Shift row.go:241, roaring shiftArray/shiftBitmap). Bits shifted past
+    the end of the shard are dropped (per-shard semantics; the executor
+    carries them across segments)."""
+    word_shift, bit_shift = divmod(n, WORD_BITS)
+    if word_shift:
+        a = jnp.roll(a, word_shift)
+        a = a.at[:word_shift].set(0)
+    if bit_shift:
+        carry = jnp.roll(a >> jnp.uint32(WORD_BITS - bit_shift), 1).at[0].set(0)
+        a = (a << jnp.uint32(bit_shift)) | carry
+    return a
+
+
+def shift(a, n=1):
+    return _shift_static(a, int(n))
+
+
+def plane_from_columns(cols):
+    """Host helper: build a [WORDS_PER_ROW] uint32 plane from shard-relative
+    column offsets (numpy, used by import paths and tests)."""
+    plane = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+    cols = np.asarray(cols, dtype=np.uint64)
+    words = (cols // WORD_BITS).astype(np.int64)
+    bits = (cols % np.uint64(WORD_BITS)).astype(np.uint32)
+    np.bitwise_or.at(plane, words, np.uint32(1) << bits)
+    return plane
+
+
+def columns_from_plane(plane):
+    """Host helper: shard-relative column offsets of set bits, sorted."""
+    plane = np.asarray(plane, dtype=np.uint32)
+    words = np.nonzero(plane)[0]
+    out = []
+    for w in words:
+        v = int(plane[w])
+        base = w * WORD_BITS
+        while v:
+            b = v & -v
+            out.append(base + b.bit_length() - 1)
+            v ^= b
+    return np.array(out, dtype=np.uint64)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topn_counts(stack, filter_plane, k):
+    """Per-row intersection counts then top-k (reference: fragment.top
+    fragment.go:1570 + cache heap merge). Returns (counts [k], slots [k]);
+    rows with zero count get slot -1 handled by the caller."""
+    counts = popcount_rows(stack & filter_plane[None, :])
+    vals, idx = jax.lax.top_k(counts, k)
+    return vals, idx

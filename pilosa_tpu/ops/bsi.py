@@ -1,0 +1,191 @@
+"""BSI (bit-sliced index) kernels.
+
+The reference stores integer values sign-magnitude across bit-plane rows of a
+`bsig_<field>` view: row 0 = existence, row 1 = sign, row 2+i = magnitude bit i
+(reference: fragment.go:91-93, value/setValue fragment.go:896-1000). Range
+queries are bit-plane scans (reference: rangeEQ/rangeLT/rangeGT/rangeLTUnsigned
+fragment.go:1292-1470); Sum/Min/Max walk planes with a narrowing filter
+(fragment.go:1068-1227).
+
+TPU-native design: instead of the reference's iterative keep/filter loops we
+compute all comparison masks in ONE branchless pass — the classic vectorized
+magnitude comparator. For each column (a bit lane across D magnitude planes):
+
+    eq_i  : magnitude so far equals the predicate's high bits
+    lt/gt : first differing bit decides
+
+which XLA unrolls over the (static, <=64) bit depth into fused elementwise ops.
+This is mathematically equivalent to the reference algorithm but has no
+data-dependent control flow — exactly what the MXU/VPU pipeline wants.
+
+Layout convention here: `planes` is a [D, W] uint32 stack, planes[i] =
+magnitude bit i (LSB first), `sign` and `exists` are [W] planes. Predicates
+arrive as a [D] uint32 0/1 vector of predicate magnitude bits (host-computed),
+so kernels never see 64-bit scalars (TPU is 32-bit native).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bitplane import popcount_rows
+
+__all__ = [
+    "predicate_bits",
+    "compare_unsigned",
+    "range_eq",
+    "range_lt",
+    "range_gt",
+    "range_between_unsigned",
+    "bsi_plane_counts",
+    "max_unsigned",
+    "min_unsigned",
+]
+
+FULL = jnp.uint32(0xFFFFFFFF)
+
+
+def predicate_bits(upredicate, depth):
+    """Host helper: magnitude bits of an unsigned predicate as a [depth]
+    uint32 0/1 vector (LSB first). Saturates: predicates wider than depth
+    are handled by the caller via the `pred_overflows` flag."""
+    return np.array(
+        [(int(upredicate) >> i) & 1 for i in range(depth)], dtype=np.uint32
+    )
+
+
+@jax.jit
+def compare_unsigned(planes, pbits):
+    """One-pass vectorized comparator of per-column magnitudes vs. predicate.
+
+    Returns (lt, eq, gt) masks, each [W]. Equivalent to the reference's
+    rangeLTUnsigned / rangeGTUnsigned / rangeEQ scans (fragment.go:1357-1470)
+    but computed simultaneously with no branching.
+    """
+    w = planes.shape[1]
+    eq = jnp.full((w,), FULL, dtype=jnp.uint32)
+    lt = jnp.zeros((w,), dtype=jnp.uint32)
+    gt = jnp.zeros((w,), dtype=jnp.uint32)
+
+    def step(carry, xs):
+        lt, eq, gt = carry
+        plane, bit = xs
+        pmask = jnp.where(bit == 1, FULL, jnp.uint32(0))
+        # Column bit set, predicate bit clear -> column > predicate (at first
+        # difference); column bit clear, predicate bit set -> column < pred.
+        gt = gt | (eq & plane & ~pmask)
+        lt = lt | (eq & ~plane & pmask)
+        eq = eq & ~(plane ^ pmask)
+        return (lt, eq, gt), None
+
+    # MSB-first scan: reverse the plane stack and predicate bits.
+    (lt, eq, gt), _ = jax.lax.scan(
+        step, (lt, eq, gt), (planes[::-1], pbits[::-1].astype(jnp.uint32))
+    )
+    return lt, eq, gt
+
+
+@jax.jit
+def range_eq(planes, sign, exists, pbits, neg_predicate):
+    """Columns whose signed value == predicate. `neg_predicate` is a traced
+    bool scalar selecting the sign slice (reference: rangeEQ fragment.go:1292)."""
+    base = jnp.where(neg_predicate, exists & sign, exists & ~sign)
+    _, eq, _ = compare_unsigned(planes, pbits)
+    return base & eq
+
+
+@jax.jit
+def range_lt(planes, sign, exists, pbits, neg_predicate, allow_eq):
+    """Columns whose signed value < predicate (<= when allow_eq).
+
+    Sign-magnitude semantics (reference: rangeLT fragment.go:1335):
+      pred >= 0: all negatives qualify; positives compare magnitudes.
+      pred <  0: only negatives, with magnitude > |pred| (reversed order).
+    """
+    pos = exists & ~sign
+    neg = exists & sign
+    lt, eq, gt = compare_unsigned(planes, pbits)
+    eq_mask = jnp.where(allow_eq, FULL, jnp.uint32(0))
+
+    pos_result = neg | (pos & (lt | (eq & eq_mask)))
+    neg_result = neg & (gt | (eq & eq_mask))
+    return jnp.where(neg_predicate, neg_result, pos_result)
+
+
+@jax.jit
+def range_gt(planes, sign, exists, pbits, neg_predicate, allow_eq):
+    """Columns whose signed value > predicate (>= when allow_eq).
+    Mirror of range_lt (reference: rangeGT fragment.go:1403)."""
+    pos = exists & ~sign
+    neg = exists & sign
+    lt, eq, gt = compare_unsigned(planes, pbits)
+    eq_mask = jnp.where(allow_eq, FULL, jnp.uint32(0))
+
+    pos_result = pos & (gt | (eq & eq_mask))
+    neg_result = pos | (neg & (lt | (eq & eq_mask)))
+    return jnp.where(neg_predicate, neg_result, pos_result)
+
+
+@jax.jit
+def range_between_unsigned(planes, filter_plane, lo_bits, hi_bits):
+    """filter ∩ {lo <= value <= hi} on magnitudes only (reference:
+    rangeBetweenUnsigned fragment.go:1489; the executor handles sign split)."""
+    lt_lo, eq_lo, _ = compare_unsigned(planes, lo_bits)
+    lt_hi, eq_hi, _ = compare_unsigned(planes, hi_bits)
+    ge_lo = ~lt_lo | eq_lo
+    le_hi = lt_hi | eq_hi
+    return filter_plane & ge_lo & le_hi
+
+
+@jax.jit
+def bsi_plane_counts(planes, sign, exists, filter_plane):
+    """Per-plane popcounts for Sum (reference: fragment.sum fragment.go:1068).
+
+    Returns (pos_counts [D], neg_counts [D], count): the host computes
+    sum = Σ 2^i·pos[i] − Σ 2^i·neg[i] in arbitrary-precision Python ints,
+    avoiding on-device 64-bit overflow.
+    """
+    consider = exists & filter_plane
+    pos = consider & ~sign
+    neg = consider & sign
+    pos_counts = popcount_rows(planes & pos[None, :])
+    neg_counts = popcount_rows(planes & neg[None, :])
+    count = jnp.sum(jax.lax.population_count(consider).astype(jnp.int32))
+    return pos_counts, neg_counts, count
+
+
+@jax.jit
+def max_unsigned(planes, filter_plane):
+    """(max magnitude, columns achieving it) under filter — MSB-down narrowing
+    walk (reference: maxUnsigned fragment.go:1139), branchless via where().
+
+    Returns (bits [D] int32 of the max value MSB-first-reversed back to LSB,
+    final filter plane). Host reassembles the integer and popcounts the plane.
+    """
+
+    def step(filt, plane):
+        cand = filt & plane
+        nonzero = jnp.any(cand != 0)
+        new_filt = jnp.where(nonzero, cand, filt)
+        return new_filt, nonzero.astype(jnp.int32)
+
+    final, bits_msb_first = jax.lax.scan(step, filter_plane, planes[::-1])
+    return bits_msb_first[::-1], final
+
+
+@jax.jit
+def min_unsigned(planes, filter_plane):
+    """(min magnitude, columns achieving it) under filter (reference:
+    minUnsigned fragment.go:1110)."""
+
+    def step(filt, plane):
+        cand = filt & ~plane
+        nonzero = jnp.any(cand != 0)
+        new_filt = jnp.where(nonzero, cand, filt)
+        # Bit participates in the min when no column can keep it clear.
+        return new_filt, (~nonzero).astype(jnp.int32)
+
+    final, bits_msb_first = jax.lax.scan(step, filter_plane, planes[::-1])
+    return bits_msb_first[::-1], final

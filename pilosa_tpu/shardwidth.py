@@ -1,0 +1,33 @@
+"""Shard geometry constants.
+
+The reference selects shard width at build time (reference: shardwidth/20.go:19,
+fragment.go:53); we fix the default exponent 20 but keep it configurable via
+environment for tests (PILOSA_TPU_SHARD_EXP).
+
+A shard covers SHARD_WIDTH consecutive columns. On device, one row of one shard
+("row plane") is a dense bitset of SHARD_WIDTH bits stored as uint32 words —
+the TPU-native replacement for roaring containers (reference: roaring/roaring.go).
+"""
+
+import os
+
+# Shard width exponent. Reference default is 20 (1Mi columns per shard).
+EXPONENT: int = int(os.environ.get("PILOSA_TPU_SHARD_EXP", "20"))
+
+# Number of columns in a shard.
+SHARD_WIDTH: int = 1 << EXPONENT
+
+# Bits per storage word on device (uint32 is TPU-native).
+WORD_BITS: int = 32
+
+# uint32 words per row plane.
+WORDS_PER_ROW: int = SHARD_WIDTH // WORD_BITS
+
+# Container geometry (host roaring interchange format, reference:
+# roaring/roaring.go:55 bitmapN): a container covers 2^16 bits.
+CONTAINER_BITS: int = 1 << 16
+WORDS_PER_CONTAINER: int = CONTAINER_BITS // WORD_BITS
+CONTAINERS_PER_SHARD: int = SHARD_WIDTH // CONTAINER_BITS
+
+# Largest container key (reference: roaring/roaring.go:60).
+MAX_CONTAINER_KEY: int = (1 << 48) - 1

@@ -1,0 +1,52 @@
+"""Naive reference implementations for differential testing.
+
+Mirrors the reference's strategy of checking every bitmap op against a plain
+implementation (reference: roaring/naive.go:29-33, roaring/fuzz_test.go) —
+here the naive side is Python sets / ints, the fast side is the device
+kernels.
+"""
+
+import numpy as np
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORD_BITS, WORDS_PER_ROW
+
+
+def plane_of(cols):
+    """Set of shard-relative columns -> dense [WORDS_PER_ROW] uint32 plane."""
+    plane = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+    for c in cols:
+        plane[c // WORD_BITS] |= np.uint32(1 << (c % WORD_BITS))
+    return plane
+
+
+def set_of(plane):
+    """Dense plane -> set of shard-relative columns."""
+    out = set()
+    plane = np.asarray(plane)
+    for w in np.nonzero(plane)[0]:
+        v = int(plane[w])
+        b = 0
+        while v:
+            if v & 1:
+                out.add(int(w) * WORD_BITS + b)
+            v >>= 1
+            b += 1
+    return out
+
+
+def random_cols(rng, n, width=SHARD_WIDTH):
+    return set(int(x) for x in rng.choice(width, size=min(n, width), replace=False))
+
+
+def bsi_planes(values, depth):
+    """Dict col->signed int -> (planes [depth, W], sign, exists) numpy arrays,
+    sign-magnitude encoding matching the reference (fragment.go:91-93)."""
+    exists = plane_of(values.keys())
+    sign = plane_of([c for c, v in values.items() if v < 0])
+    planes = np.zeros((depth, WORDS_PER_ROW), dtype=np.uint32)
+    for c, v in values.items():
+        mag = abs(int(v))
+        for i in range(depth):
+            if (mag >> i) & 1:
+                planes[i, c // WORD_BITS] |= np.uint32(1 << (c % WORD_BITS))
+    return planes, sign, exists

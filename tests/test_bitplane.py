@@ -1,0 +1,113 @@
+"""Differential tests: device bit-plane kernels vs naive set algebra.
+
+Parity model: reference roaring kernel tests (roaring/roaring_internal_test.go
+— every container-type pair for every op). Dense planes have no container
+types, so the matrix here is (density regimes) × (ops): empty / sparse (array
+regime) / dense (bitmap regime) / runs (run regime).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bitplane
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+
+from .naive import plane_of, random_cols, set_of
+
+
+def regimes(rng):
+    dense = random_cols(rng, 200_000)
+    sparse = random_cols(rng, 50)
+    runs = set()
+    for start in range(0, SHARD_WIDTH, 65536):
+        runs.update(range(start, start + 1000))
+    return {
+        "empty": set(),
+        "sparse": sparse,
+        "dense": dense,
+        "runs": runs,
+        "block": set(range(0, 70000)),
+    }
+
+
+@pytest.mark.parametrize("op,naive_op", [
+    ("intersect", lambda a, b: a & b),
+    ("union", lambda a, b: a | b),
+    ("difference", lambda a, b: a - b),
+    ("xor", lambda a, b: a ^ b),
+])
+def test_pairwise_ops(rng, op, naive_op):
+    regs = regimes(rng)
+    fn = getattr(bitplane, op)
+    for na, a in regs.items():
+        for nb, b in regs.items():
+            got = set_of(np.asarray(fn(jnp.asarray(plane_of(a)), jnp.asarray(plane_of(b)))))
+            want = naive_op(a, b)
+            assert got == want, f"{op} failed for {na} x {nb}"
+
+
+def test_popcount(rng):
+    for name, cols in regimes(rng).items():
+        got = int(bitplane.popcount(jnp.asarray(plane_of(cols))))
+        assert got == len(cols), name
+
+
+def test_count_intersect(rng):
+    regs = regimes(rng)
+    for a in regs.values():
+        for b in regs.values():
+            got = int(bitplane.count_intersect(
+                jnp.asarray(plane_of(a)), jnp.asarray(plane_of(b))))
+            assert got == len(a & b)
+
+
+def test_popcount_rows(rng):
+    sets = list(regimes(rng).values())
+    stack = jnp.asarray(np.stack([plane_of(s) for s in sets]))
+    got = np.asarray(bitplane.popcount_rows(stack))
+    assert list(got) == [len(s) for s in sets]
+
+
+def test_union_rows(rng):
+    sets = list(regimes(rng).values())
+    stack = jnp.asarray(np.stack([plane_of(s) for s in sets]))
+    got = set_of(np.asarray(bitplane.union_rows(stack)))
+    assert got == set().union(*sets)
+
+
+def test_not(rng):
+    cols = random_cols(rng, 1000)
+    got = set_of(np.asarray(bitplane.not_(jnp.asarray(plane_of(cols)))))
+    assert got == set(range(SHARD_WIDTH)) - cols
+
+
+def test_any_set(rng):
+    assert not bool(bitplane.any_set(jnp.zeros(WORDS_PER_ROW, dtype=jnp.uint32)))
+    assert bool(bitplane.any_set(jnp.asarray(plane_of({12345}))))
+
+
+@pytest.mark.parametrize("n", [1, 7, 32, 33, 100, 65536])
+def test_shift(rng, n):
+    cols = random_cols(rng, 5000)
+    got = set_of(np.asarray(bitplane.shift(jnp.asarray(plane_of(cols)), n)))
+    want = {c + n for c in cols if c + n < SHARD_WIDTH}
+    assert got == want
+
+
+def test_plane_from_columns_roundtrip(rng):
+    cols = sorted(random_cols(rng, 10_000))
+    plane = bitplane.plane_from_columns(cols)
+    assert set_of(plane) == set(cols)
+    back = bitplane.columns_from_plane(plane)
+    assert list(back) == cols
+
+
+def test_topn_counts(rng):
+    sets = [random_cols(rng, n) for n in (10, 500, 300, 800, 2)]
+    stack = jnp.asarray(np.stack([plane_of(s) for s in sets]))
+    filt = jnp.asarray(plane_of(set(range(SHARD_WIDTH))))
+    vals, idx = bitplane.topn_counts(stack, filt, 3)
+    assert list(np.asarray(vals)) == [800, 500, 300]
+    assert list(np.asarray(idx)) == [3, 1, 2]

@@ -1,0 +1,137 @@
+"""Differential tests: BSI comparator/aggregate kernels vs naive ints.
+
+Parity model: reference fragment BSI tests (fragment_internal_test.go —
+SetValue/value, rangeOp for every operator, Sum/Min/Max with filters).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bsi, bitplane
+from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+from .naive import bsi_planes, plane_of, set_of
+
+
+DEPTH = 12
+
+
+def make_values(rng, n=2000, lo=-3000, hi=3000):
+    cols = rng.choice(100_000, size=n, replace=False)
+    vals = rng.integers(lo, hi, size=n)
+    return {int(c): int(v) for c, v in zip(cols, vals)}
+
+
+def dev(values, depth=DEPTH):
+    planes, sign, exists = bsi_planes(values, depth)
+    return jnp.asarray(planes), jnp.asarray(sign), jnp.asarray(exists)
+
+
+@pytest.mark.parametrize("predicate", [-3000, -700, -1, 0, 1, 42, 1234, 2999])
+def test_range_eq(rng, predicate):
+    values = make_values(rng)
+    values[55] = predicate  # ensure at least one hit
+    planes, sign, exists = dev(values)
+    pbits = jnp.asarray(bsi.predicate_bits(abs(predicate), DEPTH))
+    got = set_of(np.asarray(bsi.range_eq(planes, sign, exists, pbits, predicate < 0)))
+    want = {c for c, v in values.items() if v == predicate}
+    assert got == want
+
+
+@pytest.mark.parametrize("predicate", [-3001, -700, -1, 0, 1, 42, 1234, 2999])
+@pytest.mark.parametrize("allow_eq", [False, True])
+def test_range_lt(rng, predicate, allow_eq):
+    values = make_values(rng)
+    planes, sign, exists = dev(values)
+    pbits = jnp.asarray(bsi.predicate_bits(abs(predicate), DEPTH))
+    got = set_of(np.asarray(
+        bsi.range_lt(planes, sign, exists, pbits, predicate < 0, allow_eq)))
+    if allow_eq:
+        want = {c for c, v in values.items() if v <= predicate}
+    else:
+        want = {c for c, v in values.items() if v < predicate}
+    assert got == want
+
+
+@pytest.mark.parametrize("predicate", [-3001, -700, -1, 0, 1, 42, 1234, 2999])
+@pytest.mark.parametrize("allow_eq", [False, True])
+def test_range_gt(rng, predicate, allow_eq):
+    values = make_values(rng)
+    planes, sign, exists = dev(values)
+    pbits = jnp.asarray(bsi.predicate_bits(abs(predicate), DEPTH))
+    got = set_of(np.asarray(
+        bsi.range_gt(planes, sign, exists, pbits, predicate < 0, allow_eq)))
+    if allow_eq:
+        want = {c for c, v in values.items() if v >= predicate}
+    else:
+        want = {c for c, v in values.items() if v > predicate}
+    assert got == want
+
+
+def test_range_between_unsigned(rng):
+    values = {c: abs(v) for c, v in make_values(rng).items()}
+    planes, sign, exists = dev(values)
+    lo, hi = 100, 900
+    got = set_of(np.asarray(bsi.range_between_unsigned(
+        planes, exists,
+        jnp.asarray(bsi.predicate_bits(lo, DEPTH)),
+        jnp.asarray(bsi.predicate_bits(hi, DEPTH)))))
+    want = {c for c, v in values.items() if lo <= v <= hi}
+    assert got == want
+
+
+def test_sum_counts(rng):
+    values = make_values(rng)
+    planes, sign, exists = dev(values)
+    full = jnp.asarray(plane_of(set(range(0, 100_000))))
+    pos, neg, count = bsi.bsi_plane_counts(planes, sign, exists, full)
+    pos, neg = np.asarray(pos), np.asarray(neg)
+    total = sum(int(pos[i]) << i for i in range(DEPTH)) - sum(
+        int(neg[i]) << i for i in range(DEPTH))
+    assert total == sum(values.values())
+    assert int(count) == len(values)
+
+
+def test_sum_with_filter(rng):
+    values = make_values(rng)
+    keep = {c for c in values if c % 3 == 0}
+    planes, sign, exists = dev(values)
+    filt = jnp.asarray(plane_of(keep))
+    pos, neg, count = bsi.bsi_plane_counts(planes, sign, exists, filt)
+    pos, neg = np.asarray(pos), np.asarray(neg)
+    total = sum(int(pos[i]) << i for i in range(DEPTH)) - sum(
+        int(neg[i]) << i for i in range(DEPTH))
+    assert total == sum(values[c] for c in keep)
+    assert int(count) == len(keep)
+
+
+def test_max_min_unsigned(rng):
+    values = {c: abs(v) for c, v in make_values(rng).items()}
+    planes, sign, exists = dev(values)
+    bits, final = bsi.max_unsigned(planes, exists)
+    got_max = sum(int(b) << i for i, b in enumerate(np.asarray(bits)))
+    want_max = max(values.values())
+    assert got_max == want_max
+    assert set_of(np.asarray(final)) == {c for c, v in values.items() if v == want_max}
+
+    bits, final = bsi.min_unsigned(planes, exists)
+    got_min = sum(int(b) << i for i, b in enumerate(np.asarray(bits)))
+    want_min = min(values.values())
+    assert got_min == want_min
+    assert set_of(np.asarray(final)) == {c for c, v in values.items() if v == want_min}
+
+
+def test_compare_unsigned_exhaustive_small(rng):
+    # Every magnitude in [0, 16) vs every predicate in [0, 16), depth 4.
+    values = {c: c % 16 for c in range(64)}
+    planes, sign, exists = bsi_planes(values, 4)
+    planes = jnp.asarray(planes)
+    for pred in range(16):
+        pbits = jnp.asarray(bsi.predicate_bits(pred, 4))
+        lt, eq, gt = bsi.compare_unsigned(planes, pbits)
+        lt, eq, gt = (set_of(np.asarray(x)) & set(values) for x in (lt, eq, gt))
+        assert lt == {c for c, v in values.items() if v < pred}, pred
+        assert eq == {c for c, v in values.items() if v == pred}, pred
+        assert gt == {c for c, v in values.items() if v > pred}, pred
