@@ -124,7 +124,12 @@ def _shift_static(a, n):
 
 
 def shift(a, n=1):
-    return _shift_static(a, int(n))
+    n = int(n)
+    if n < 0:
+        raise ValueError("shift supports non-negative n only (toward higher columns)")
+    if n == 0:
+        return a
+    return _shift_static(a, n)
 
 
 def plane_from_columns(cols):
@@ -156,8 +161,9 @@ def columns_from_plane(plane):
 @partial(jax.jit, static_argnames=("k",))
 def topn_counts(stack, filter_plane, k):
     """Per-row intersection counts then top-k (reference: fragment.top
-    fragment.go:1570 + cache heap merge). Returns (counts [k], slots [k]);
-    rows with zero count get slot -1 handled by the caller."""
+    fragment.go:1570 + cache heap merge). Returns (counts [k], slots [k]).
+    top_k returns real slot indices even for zero counts — callers MUST drop
+    entries with count == 0 (the reference's top excludes empty rows)."""
     counts = popcount_rows(stack & filter_plane[None, :])
     vals, idx = jax.lax.top_k(counts, k)
     return vals, idx

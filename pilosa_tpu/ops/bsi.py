@@ -49,8 +49,16 @@ FULL = jnp.uint32(0xFFFFFFFF)
 
 def predicate_bits(upredicate, depth):
     """Host helper: magnitude bits of an unsigned predicate as a [depth]
-    uint32 0/1 vector (LSB first). Saturates: predicates wider than depth
-    are handled by the caller via the `pred_overflows` flag."""
+    uint32 0/1 vector (LSB first).
+
+    Raises ValueError when the predicate doesn't fit in `depth` bits: the
+    correct result then depends on the comparison operator (everything is LT
+    an over-wide predicate, nothing is EQ/GT it), so the executor must clamp
+    BEFORE building bits (see exec layer rangeOp handling)."""
+    if int(upredicate) >> depth:
+        raise ValueError(
+            f"predicate magnitude {upredicate} does not fit in bitDepth {depth}; "
+            "caller must clamp")
     return np.array(
         [(int(upredicate) >> i) & 1 for i in range(depth)], dtype=np.uint32
     )
@@ -132,11 +140,10 @@ def range_gt(planes, sign, exists, pbits, neg_predicate, allow_eq):
 def range_between_unsigned(planes, filter_plane, lo_bits, hi_bits):
     """filter ∩ {lo <= value <= hi} on magnitudes only (reference:
     rangeBetweenUnsigned fragment.go:1489; the executor handles sign split)."""
-    lt_lo, eq_lo, _ = compare_unsigned(planes, lo_bits)
+    lt_lo, _, _ = compare_unsigned(planes, lo_bits)
     lt_hi, eq_hi, _ = compare_unsigned(planes, hi_bits)
-    ge_lo = ~lt_lo | eq_lo
     le_hi = lt_hi | eq_hi
-    return filter_plane & ge_lo & le_hi
+    return filter_plane & ~lt_lo & le_hi
 
 
 @jax.jit

@@ -1,0 +1,22 @@
+"""Host-side roaring bitmap layer: storage + interchange format.
+
+The reference's roaring package is its compute engine; here it is the at-rest
+format feeding the dense TPU plane path (see pilosa_tpu.ops)."""
+
+from .bitmap import Bitmap, CONTAINER_BITS, MAX_CONTAINER_KEY
+from .codec import (
+    FormatError,
+    MAGIC_NUMBER,
+    OP_ADD,
+    OP_ADD_BATCH,
+    OP_ADD_ROARING,
+    OP_REMOVE,
+    OP_REMOVE_BATCH,
+    OP_REMOVE_ROARING,
+    decode_op,
+    deserialize,
+    encode_op,
+    merge_bitmaps,
+    serialize,
+)
+from .containers import Container

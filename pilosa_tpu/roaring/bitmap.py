@@ -1,0 +1,237 @@
+"""64-bit roaring bitmap (host side).
+
+The reference's `roaring.Bitmap` (roaring/roaring.go:145) is both the storage
+format and the compute engine. Here it is storage + mutation only: a sorted
+map of container-key -> Container, where key = bit >> 16. Set algebra runs on
+device planes; this class feeds the dense upload path and the (de)serializer.
+"""
+
+import bisect
+
+import numpy as np
+
+from .containers import Container, popcount32
+
+CONTAINER_BITS = 1 << 16
+MAX_CONTAINER_KEY = (1 << 48) - 1  # reference: roaring/roaring.go:60
+
+
+class Bitmap:
+    """Mutable 64-bit bitmap over sorted containers."""
+
+    __slots__ = ("containers", "_keys", "ops", "op_n")
+
+    def __init__(self):
+        self.containers = {}  # key -> Container
+        self._keys = []  # sorted container keys
+        # In-memory op log (WAL). The fragment layer appends serialized ops
+        # to the storage file; this list only tracks unsnapshotted op count.
+        self.ops = 0
+        self.op_n = 0
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_bits(cls, bits):
+        b = cls()
+        b.add_many(bits)
+        return b
+
+    # -- container map ------------------------------------------------------
+
+    def _get(self, key, create=False):
+        c = self.containers.get(key)
+        if c is None and create:
+            c = Container()
+            self.containers[key] = c
+            bisect.insort(self._keys, key)
+        return c
+
+    def _drop_if_empty(self, key):
+        c = self.containers.get(key)
+        if c is not None and c.n == 0:
+            del self.containers[key]
+            self._keys.remove(key)
+
+    def keys(self):
+        return self._keys
+
+    # -- mutation -----------------------------------------------------------
+
+    def add(self, bit):
+        """DirectAdd (reference: roaring.go:228). Returns changed."""
+        bit = int(bit)
+        return self._get(bit >> 16, create=True).add(bit & 0xFFFF)
+
+    def remove(self, bit):
+        bit = int(bit)
+        key = bit >> 16
+        c = self.containers.get(key)
+        if c is None:
+            return False
+        changed = c.remove(bit & 0xFFFF)
+        if changed:
+            self._drop_if_empty(key)
+        return changed
+
+    def add_many(self, bits):
+        """Vectorized bulk add; returns number of newly-set bits
+        (reference: DirectAddN)."""
+        bits = np.asarray(bits, dtype=np.uint64)
+        if len(bits) == 0:
+            return 0
+        keys = bits >> np.uint64(16)
+        low = (bits & np.uint64(0xFFFF)).astype(np.uint16)
+        changed = 0
+        order = np.argsort(keys, kind="stable")
+        keys, low = keys[order], low[order]
+        boundaries = np.concatenate(
+            [[0], np.nonzero(np.diff(keys))[0] + 1, [len(keys)]])
+        for i in range(len(boundaries) - 1):
+            s, e = boundaries[i], boundaries[i + 1]
+            key = int(keys[s])
+            changed += self._get(key, create=True).add_many(low[s:e])
+        return changed
+
+    def remove_many(self, bits):
+        bits = np.asarray(bits, dtype=np.uint64)
+        if len(bits) == 0:
+            return 0
+        keys = bits >> np.uint64(16)
+        low = (bits & np.uint64(0xFFFF)).astype(np.uint16)
+        changed = 0
+        order = np.argsort(keys, kind="stable")
+        keys, low = keys[order], low[order]
+        boundaries = np.concatenate(
+            [[0], np.nonzero(np.diff(keys))[0] + 1, [len(keys)]])
+        for i in range(len(boundaries) - 1):
+            s, e = boundaries[i], boundaries[i + 1]
+            key = int(keys[s])
+            c = self.containers.get(key)
+            if c is None:
+                continue
+            changed += c.remove_many(low[s:e])
+            self._drop_if_empty(key)
+        return changed
+
+    # -- queries (host-side; only used off the hot path) --------------------
+
+    def contains(self, bit):
+        bit = int(bit)
+        c = self.containers.get(bit >> 16)
+        return c is not None and c.contains(bit & 0xFFFF)
+
+    def count(self):
+        return sum(c.n for c in self.containers.values())
+
+    def count_range(self, start, end):
+        """Count of set bits in [start, end) (reference: CountRange)."""
+        total = 0
+        for key in self._keys:
+            base = key << 16
+            if base >= end:
+                break
+            if base + CONTAINER_BITS <= start:
+                continue
+            c = self.containers[key]
+            if start <= base and base + CONTAINER_BITS <= end:
+                total += c.n
+            else:
+                vals = c.to_values().astype(np.int64) + base
+                total += int(np.sum((vals >= start) & (vals < end)))
+        return total
+
+    def slice_range(self, start, end):
+        """Sorted bit positions in [start, end) (reference: SliceRange)."""
+        out = []
+        for key in self._keys:
+            base = key << 16
+            if base >= end:
+                break
+            if base + CONTAINER_BITS <= start:
+                continue
+            vals = self.containers[key].to_values().astype(np.uint64) + np.uint64(base)
+            if start > base or base + CONTAINER_BITS > end:
+                vals = vals[(vals >= start) & (vals < end)]
+            out.append(vals)
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def max(self):
+        if not self._keys:
+            return 0
+        key = self._keys[-1]
+        return (key << 16) | int(self.containers[key].to_values()[-1])
+
+    def any(self):
+        return bool(self._keys)
+
+    # -- dense plane interface (TPU upload path) ----------------------------
+
+    def dense_range_words(self, key_start, key_count):
+        """Concatenate dense words for containers [key_start, key_start+key_count)
+        into one [key_count*2048] uint32 plane. This is the reference's
+        OffsetRange row-slicing (roaring.go:537) recast as densification."""
+        from ..shardwidth import WORDS_PER_CONTAINER
+
+        plane = np.zeros(key_count * WORDS_PER_CONTAINER, dtype=np.uint32)
+        i = bisect.bisect_left(self._keys, key_start)
+        while i < len(self._keys) and self._keys[i] < key_start + key_count:
+            key = self._keys[i]
+            off = (key - key_start) * WORDS_PER_CONTAINER
+            plane[off:off + WORDS_PER_CONTAINER] = self.containers[key].to_dense_words()
+            i += 1
+        return plane
+
+    def merge_dense_words(self, key_start, plane, clear=False):
+        """Inverse of dense_range_words: fold a dense plane back into
+        containers (set union, or clear when clear=True). Returns changed
+        bit count. Used by snapshotting and Store/ClearRow writes."""
+        from ..shardwidth import WORDS_PER_CONTAINER
+
+        changed = 0
+        n_keys = len(plane) // WORDS_PER_CONTAINER
+        for k in range(n_keys):
+            words = plane[k * WORDS_PER_CONTAINER:(k + 1) * WORDS_PER_CONTAINER]
+            if not words.any():
+                continue
+            key = key_start + k
+            c = self._get(key, create=not clear)
+            if c is None:
+                continue
+            merged = c.to_dense_words().copy()
+            if clear:
+                merged &= ~words
+            else:
+                merged |= words
+            n = int(np.sum(popcount32(merged)))
+            delta = n - c.n
+            c._become_dense(merged, n)
+            changed += abs(delta)
+            self._drop_if_empty(key)
+        return changed
+
+    def replace_dense_words(self, key_start, key_count, plane):
+        """Overwrite containers [key_start, key_start+key_count) with plane
+        contents exactly (used when writing back a fully-computed row)."""
+        from ..shardwidth import WORDS_PER_CONTAINER
+
+        for k in range(key_count):
+            key = key_start + k
+            words = np.ascontiguousarray(
+                plane[k * WORDS_PER_CONTAINER:(k + 1) * WORDS_PER_CONTAINER])
+            n = int(np.sum(popcount32(words)))
+            if n == 0:
+                if key in self.containers:
+                    del self.containers[key]
+                    self._keys.remove(key)
+                continue
+            c = self._get(key, create=True)
+            c._become_dense(words.copy(), n)
+
+    def clone(self):
+        b = Bitmap()
+        b.containers = {k: c.clone() for k, c in self.containers.items()}
+        b._keys = list(self._keys)
+        return b

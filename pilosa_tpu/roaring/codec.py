@@ -1,0 +1,313 @@
+"""Roaring file codec: Pilosa variant (read+write) and official spec (read).
+
+Format (reference: docs/architecture.md:9-25, roaring/roaring.go:30-62,
+writeToUnoptimized roaring.go:1054-1127, pilosa/official iterators
+roaring.go:1174-1420):
+
+Pilosa variant, all little-endian:
+  bytes 0-1  magic 12348
+  byte  2    storage version (0)
+  byte  3    user flags (bit 0 = BSI v2 marker, fragment.go:97)
+  bytes 4-7  container count
+  then per-container descriptive header (12B): key u64, type u16 (1=array,
+    2=bitmap, 3=run), cardinality-1 u16
+  then per-container offset header (4B): absolute byte offset of payload
+  payloads: array = n×u16; bitmap = 8192B; run = count u16 + count×[start,last] u16
+  then an op log until EOF (see ops below).
+
+Official spec (read-only import path): cookie 12346 (no runs; count u32
+follows) or 12347 (count-1 in cookie high 16 bits; run-flag bitset follows);
+16-bit keys; runs stored as [start, length].
+
+Ops (reference: op.WriteTo/UnmarshalBinary roaring.go:4694-4793): 13-byte
+header = type u8, value u64, fnv1a-32 checksum u32 (over bytes 0:9 plus
+payload); batch ops append count×u64 values at byte 13; roaring ops append
+opN u32 then an embedded roaring blob.
+"""
+
+import struct
+
+import numpy as np
+
+from .bitmap import Bitmap
+from .containers import (
+    ARRAY_MAX_SIZE,
+    BITMAP_BYTES,
+    Container,
+    TYPE_ARRAY,
+    TYPE_BITMAP,
+    TYPE_RUN,
+)
+
+MAGIC_NUMBER = 12348
+STORAGE_VERSION = 0
+HEADER_BASE_SIZE = 8
+OFFICIAL_COOKIE = 12346  # serialCookieNoRunContainer
+OFFICIAL_COOKIE_RUNS = 12347  # serialCookie
+
+OP_ADD = 0
+OP_REMOVE = 1
+OP_ADD_BATCH = 2
+OP_REMOVE_BATCH = 3
+OP_ADD_ROARING = 4
+OP_REMOVE_ROARING = 5
+
+
+class FormatError(Exception):
+    pass
+
+
+def fnv1a32(*chunks):
+    h = 2166136261
+    for chunk in chunks:
+        for b in chunk:
+            h ^= b
+            h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Serialization (Pilosa format)
+# ---------------------------------------------------------------------------
+
+def serialize(bitmap, flags=0, optimize=True):
+    """Bitmap -> Pilosa-format bytes (no op log — the WAL is appended by the
+    fragment storage layer)."""
+    items = []
+    for key in bitmap.keys():
+        c = bitmap.containers[key]
+        if c.n == 0:
+            continue
+        items.append((key, c.optimized() if optimize else c))
+
+    out = bytearray()
+    out += struct.pack("<HBB", MAGIC_NUMBER, STORAGE_VERSION, flags)
+    out += struct.pack("<I", len(items))
+    for key, c in items:
+        out += struct.pack("<QHH", key, c.typ, c.n - 1)
+    offset = HEADER_BASE_SIZE + len(items) * 16
+    for _, c in items:
+        out += struct.pack("<I", offset)
+        offset += c.serialized_size()
+    for _, c in items:
+        out += _container_payload(c)
+    return bytes(out)
+
+
+def _container_payload(c):
+    if c.typ == TYPE_ARRAY:
+        return np.ascontiguousarray(c.values, dtype="<u2").tobytes()
+    if c.typ == TYPE_BITMAP:
+        return np.ascontiguousarray(c.words, dtype="<u4").tobytes()
+    runs = np.ascontiguousarray(c.runs, dtype="<u2")
+    return struct.pack("<H", len(runs)) + runs.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Deserialization
+# ---------------------------------------------------------------------------
+
+def deserialize(data, with_ops=True):
+    """Bytes -> (Bitmap, flags, op_count). Accepts both Pilosa and official
+    formats; replays any trailing op log (Pilosa format only)."""
+    if len(data) < 8:
+        raise FormatError(f"buffer too small: {len(data)} bytes")
+    magic = struct.unpack_from("<H", data, 0)[0]
+    if magic == MAGIC_NUMBER:
+        return _deserialize_pilosa(data, with_ops)
+    cookie = struct.unpack_from("<I", data, 0)[0]
+    if cookie == OFFICIAL_COOKIE or cookie & 0xFFFF == OFFICIAL_COOKIE_RUNS:
+        b, pos = _deserialize_official(data)
+        return b, 0, 0
+    raise FormatError(f"unknown roaring magic: {magic}")
+
+
+def _deserialize_pilosa(data, with_ops):
+    version = data[2]
+    if version != STORAGE_VERSION:
+        raise FormatError(f"wrong roaring version: {version}")
+    flags = data[3]
+    n_keys = struct.unpack_from("<I", data, 4)[0]
+    b = Bitmap()
+    if n_keys == 0:
+        op_count = _replay_ops(b, data, HEADER_BASE_SIZE) if with_ops and len(data) > HEADER_BASE_SIZE else 0
+        return b, flags, op_count
+
+    header_end = HEADER_BASE_SIZE + n_keys * 12
+    offsets_end = header_end + n_keys * 4
+    if len(data) < offsets_end:
+        raise FormatError("insufficient data for headers")
+
+    last_end = offsets_end
+    for i in range(n_keys):
+        key, typ, n_minus_1 = struct.unpack_from("<QHH", data, HEADER_BASE_SIZE + i * 12)
+        n = n_minus_1 + 1
+        offset = struct.unpack_from("<I", data, header_end + i * 4)[0]
+        c, end = _read_container(data, offset, typ, n)
+        b.containers[key] = c
+        b._keys.append(key)
+        last_end = max(last_end, end)
+    b._keys.sort()
+
+    op_count = _replay_ops(b, data, last_end) if with_ops and len(data) > last_end else 0
+    return b, flags, op_count
+
+
+def _read_container(data, offset, typ, n):
+    if typ == TYPE_ARRAY:
+        end = offset + 2 * n
+        values = np.frombuffer(data, dtype="<u2", count=n, offset=offset).copy()
+        return Container(TYPE_ARRAY, values=values, n=n), end
+    if typ == TYPE_BITMAP:
+        end = offset + BITMAP_BYTES
+        words = np.frombuffer(data, dtype="<u4", count=BITMAP_BYTES // 4, offset=offset).copy()
+        return Container(TYPE_BITMAP, words=words, n=n), end
+    if typ == TYPE_RUN:
+        run_count = struct.unpack_from("<H", data, offset)[0]
+        end = offset + 2 + 4 * run_count
+        runs = np.frombuffer(data, dtype="<u2", count=run_count * 2, offset=offset + 2)
+        return Container(TYPE_RUN, runs=runs.reshape(-1, 2).copy(), n=n), end
+    raise FormatError(f"unknown container type {typ}")
+
+
+def _deserialize_official(data):
+    cookie = struct.unpack_from("<I", data, 0)[0]
+    pos = 4
+    if cookie == OFFICIAL_COOKIE:
+        n_keys = struct.unpack_from("<I", data, pos)[0]
+        pos += 4
+        run_flags = None
+    else:
+        n_keys = (cookie >> 16) + 1
+        nbytes = (n_keys + 7) // 8
+        run_flags = data[pos:pos + nbytes]
+        pos += nbytes
+
+    headers = []
+    for i in range(n_keys):
+        key, card_minus_1 = struct.unpack_from("<HH", data, pos)
+        pos += 4
+        headers.append((key, card_minus_1 + 1))
+
+    # Offset section present only in the no-runs variant (the reference
+    # ignores it and walks sequentially either way; we do the same).
+    if run_flags is None:
+        pos += 4 * n_keys
+
+    b = Bitmap()
+    for i, (key, n) in enumerate(headers):
+        is_run = run_flags is not None and (run_flags[i // 8] >> (i % 8)) & 1
+        if is_run:
+            run_count = struct.unpack_from("<H", data, pos)[0]
+            pos += 2
+            runs = np.frombuffer(data, dtype="<u2", count=run_count * 2, offset=pos).reshape(-1, 2).astype(np.uint32)
+            pos += 4 * run_count
+            # Official runs are [start, length-1]; convert to [start, last].
+            runs[:, 1] = runs[:, 0] + runs[:, 1]
+            c = Container(TYPE_RUN, runs=runs.astype(np.uint16), n=n)
+        elif n <= ARRAY_MAX_SIZE:
+            values = np.frombuffer(data, dtype="<u2", count=n, offset=pos).copy()
+            pos += 2 * n
+            c = Container(TYPE_ARRAY, values=values, n=n)
+        else:
+            words = np.frombuffer(data, dtype="<u4", count=BITMAP_BYTES // 4, offset=pos).copy()
+            pos += BITMAP_BYTES
+            c = Container(TYPE_BITMAP, words=words, n=n)
+        b.containers[key] = c
+        b._keys.append(key)
+    return b, pos
+
+
+# ---------------------------------------------------------------------------
+# Op log
+# ---------------------------------------------------------------------------
+
+def encode_op(typ, value=0, values=None, roaring=None, op_n=0):
+    if typ in (OP_ADD, OP_REMOVE):
+        head = struct.pack("<BQ", typ, value)
+        chk = fnv1a32(head)
+        return head + struct.pack("<I", chk)
+    if typ in (OP_ADD_BATCH, OP_REMOVE_BATCH):
+        values = np.asarray(values, dtype="<u8")
+        head = struct.pack("<BQ", typ, len(values))
+        payload = values.tobytes()
+        chk = fnv1a32(head, payload)
+        return head + struct.pack("<I", chk) + payload
+    if typ in (OP_ADD_ROARING, OP_REMOVE_ROARING):
+        head = struct.pack("<BQ", typ, len(roaring))
+        payload = struct.pack("<I", op_n)
+        chk = fnv1a32(head, payload, roaring)
+        return head + struct.pack("<I", chk) + payload + roaring
+    raise ValueError(f"unknown op type {typ}")
+
+
+def decode_op(data, pos):
+    """Decode one op at pos; returns (typ, value, values, roaring, op_n, next_pos).
+    Raises FormatError on truncation/corruption (the fragment layer treats a
+    bad tail as end-of-log, like the reference's op-log replay)."""
+    if len(data) - pos < 13:
+        raise FormatError("op truncated")
+    typ = data[pos]
+    value = struct.unpack_from("<Q", data, pos + 1)[0]
+    chk = struct.unpack_from("<I", data, pos + 9)[0]
+    head = data[pos:pos + 9]
+    if typ in (OP_ADD, OP_REMOVE):
+        if fnv1a32(head) != chk:
+            raise FormatError("op checksum mismatch")
+        return typ, value, None, None, 0, pos + 13
+    if typ in (OP_ADD_BATCH, OP_REMOVE_BATCH):
+        end = pos + 13 + value * 8
+        if len(data) < end:
+            raise FormatError("batch op truncated")
+        payload = data[pos + 13:end]
+        if fnv1a32(head, payload) != chk:
+            raise FormatError("op checksum mismatch")
+        values = np.frombuffer(payload, dtype="<u8")
+        return typ, 0, values, None, 0, end
+    if typ in (OP_ADD_ROARING, OP_REMOVE_ROARING):
+        end = pos + 17 + value
+        if len(data) < end:
+            raise FormatError("roaring op truncated")
+        op_n = struct.unpack_from("<I", data, pos + 13)[0]
+        roaring = data[pos + 17:end]
+        if fnv1a32(head, data[pos + 13:pos + 17], roaring) != chk:
+            raise FormatError("op checksum mismatch")
+        return typ, 0, None, roaring, op_n, end
+    raise FormatError(f"unknown op type {typ}")
+
+
+def _replay_ops(bitmap, data, pos):
+    """Apply the op log to a freshly-loaded bitmap (reference: op.apply
+    roaring.go:4671, replay in unmarshal path). Returns op count applied."""
+    count = 0
+    while pos < len(data):
+        try:
+            typ, value, values, roaring, op_n, pos = decode_op(data, pos)
+        except FormatError:
+            break
+        if typ == OP_ADD:
+            bitmap.add(value)
+        elif typ == OP_REMOVE:
+            bitmap.remove(value)
+        elif typ == OP_ADD_BATCH:
+            bitmap.add_many(values)
+        elif typ == OP_REMOVE_BATCH:
+            bitmap.remove_many(values)
+        elif typ == OP_ADD_ROARING:
+            other, _, _ = deserialize(roaring, with_ops=False)
+            merge_bitmaps(bitmap, other, clear=False)
+        elif typ == OP_REMOVE_ROARING:
+            other, _, _ = deserialize(roaring, with_ops=False)
+            merge_bitmaps(bitmap, other, clear=True)
+        count += 1
+    return count
+
+
+def merge_bitmaps(dst, src, clear=False):
+    """Union (or clear) src into dst container-by-container (reference:
+    ImportRoaringBits roaring.go:1511). Returns changed bit count."""
+    changed = 0
+    for key in src.keys():
+        words = src.containers[key].to_dense_words()
+        changed += dst.merge_dense_words(key, words, clear=clear)
+    return changed

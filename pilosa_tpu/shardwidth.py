@@ -11,8 +11,12 @@ the TPU-native replacement for roaring containers (reference: roaring/roaring.go
 
 import os
 
-# Shard width exponent. Reference default is 20 (1Mi columns per shard).
+# Shard width exponent. Reference default is 20 (1Mi columns per shard);
+# the reference supports 16..32 via build tags. Below 16 a shard would be
+# smaller than one roaring container, breaking interchange geometry.
 EXPONENT: int = int(os.environ.get("PILOSA_TPU_SHARD_EXP", "20"))
+if not 16 <= EXPONENT <= 32:
+    raise ValueError(f"PILOSA_TPU_SHARD_EXP must be in [16, 32], got {EXPONENT}")
 
 # Number of columns in a shard.
 SHARD_WIDTH: int = 1 << EXPONENT
