@@ -1,0 +1,9 @@
+"""Host metadata tree: holder -> index -> field -> view -> fragment, plus
+the Row result type (reference layer map: SURVEY.md §1)."""
+
+from .field import Field, FieldOptions
+from .fragment import Fragment
+from .holder import Holder, SnapshotQueue
+from .index import EXISTENCE_FIELD_NAME, Index, IndexOptions
+from .row import Row
+from .view import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, View

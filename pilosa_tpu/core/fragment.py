@@ -1,0 +1,444 @@
+"""Fragment: the (index, field, view, shard) storage unit.
+
+Reference: fragment.go:100. There, a fragment is an mmap'd roaring file plus
+an appended op log; bit position = rowID*ShardWidth + colID%ShardWidth
+(fragment.go:3090). Here the same roaring file (+WAL) is the at-rest format,
+while the query-time representation is dense row planes in device HBM:
+`row_device(rowID)` densifies the row's containers into a [WORDS_PER_ROW]
+uint32 array and caches it on device, invalidated by writes. All set algebra
+on those planes happens in the executor via pilosa_tpu.ops.
+
+Durability model (reference: fragment.go:2311-2395, roaring op log):
+  file = roaring snapshot ++ op log. Every mutation appends an op record;
+  when the op count exceeds max_op_n (default 10k) the fragment is
+  snapshotted (file rewritten via temp+rename, op log reset).
+"""
+
+import os
+import hashlib
+import threading
+
+import numpy as np
+
+from ..roaring import (
+    Bitmap,
+    OP_ADD,
+    OP_ADD_BATCH,
+    OP_ADD_ROARING,
+    OP_REMOVE,
+    OP_REMOVE_BATCH,
+    OP_REMOVE_ROARING,
+    deserialize,
+    encode_op,
+    merge_bitmaps,
+    serialize,
+)
+from ..shardwidth import (
+    CONTAINERS_PER_SHARD,
+    SHARD_WIDTH,
+    WORDS_PER_CONTAINER,
+    WORDS_PER_ROW,
+)
+
+# Number of rows per merkle hash block (reference: fragment.go:80).
+HASH_BLOCK_SIZE = 100
+
+# Default op threshold before snapshotting (reference: fragment.go:85).
+DEFAULT_MAX_OP_N = 10_000
+
+# BSI row layout (reference: fragment.go:91-93).
+BSI_EXISTS_BIT = 0
+BSI_SIGN_BIT = 1
+BSI_OFFSET_BIT = 2
+
+# Boolean field rows (reference: fragment.go:88-89).
+FALSE_ROW_ID = 0
+TRUE_ROW_ID = 1
+
+
+class Fragment:
+    def __init__(self, path, index, field, view, shard,
+                 max_op_n=DEFAULT_MAX_OP_N, snapshot_queue=None, mutexed=False):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.max_op_n = max_op_n
+        self.snapshot_queue = snapshot_queue
+        self.mutexed = mutexed
+
+        self.storage = Bitmap()
+        self.op_n = 0
+        self.flags = 0
+        self._file = None
+        self._lock = threading.RLock()
+
+        # Device plane cache: rowID -> jax array; bumped generation
+        # invalidates derived stacks.
+        self._row_cache = {}
+        self.generation = 0
+
+        # Block checksums cache (anti-entropy; reference fragment.checksums).
+        self._checksums = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self):
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                self.storage, self.flags, self.op_n = deserialize(data)
+                if self.op_n > self.max_op_n:
+                    self._snapshot_locked()
+            else:
+                # Fresh fragment: seed the file with an empty-bitmap snapshot
+                # header so appended WAL ops always follow a valid roaring
+                # section (the reference's file is likewise snapshot ++ ops).
+                with open(self.path, "wb") as f:
+                    f.write(serialize(self.storage, flags=self.flags))
+            self._file = open(self.path, "ab")
+        return self
+
+    def close(self):
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+            self._row_cache.clear()
+
+    @property
+    def is_open(self):
+        return self._file is not None
+
+    # -- positions ----------------------------------------------------------
+
+    def pos(self, row_id, column_id):
+        """Bit position in storage (reference: fragment.pos fragment.go:3090)."""
+        if column_id // SHARD_WIDTH != self.shard:
+            raise ValueError(
+                f"column:{column_id} out of bounds for shard {self.shard}")
+        return row_id * SHARD_WIDTH + column_id % SHARD_WIDTH
+
+    # -- single-bit mutation -------------------------------------------------
+
+    def set_bit(self, row_id, column_id):
+        with self._lock:
+            if self.mutexed:
+                self._handle_mutex(row_id, column_id)
+            return self._set_bit_locked(row_id, column_id)
+
+    def _set_bit_locked(self, row_id, column_id):
+        pos = self.pos(row_id, column_id)
+        changed = self.storage.add(pos)
+        if changed:
+            self._append_op(encode_op(OP_ADD, value=pos))
+            self._invalidate_row(row_id)
+        return changed
+
+    def clear_bit(self, row_id, column_id):
+        with self._lock:
+            return self._clear_bit_locked(row_id, column_id)
+
+    def _clear_bit_locked(self, row_id, column_id):
+        pos = self.pos(row_id, column_id)
+        changed = self.storage.remove(pos)
+        if changed:
+            self._append_op(encode_op(OP_REMOVE, value=pos))
+            self._invalidate_row(row_id)
+        return changed
+
+    def _handle_mutex(self, row_id, column_id):
+        """Clear this column from any other row (reference: handleMutex
+        fragment.go:670 via mutexVector)."""
+        existing = self.row_for_column(column_id)
+        if existing is not None and existing != row_id:
+            self._clear_bit_locked(existing, column_id)
+
+    def row_for_column(self, column_id):
+        """First row containing the column, or None (mutex vector lookup,
+        reference: rowsVector fragment.go:3102)."""
+        for row_id in self.row_ids():
+            if self.storage.contains(self.pos(row_id, column_id)):
+                return row_id
+        return None
+
+    def rows_for_columns(self, column_ids):
+        """{column_id: row_id} for the given columns, one vectorized
+        intersection per existing row — avoids per-column full scans in
+        mutex bulk imports."""
+        col_by_offset = {int(c) % SHARD_WIDTH: int(c) for c in column_ids}
+        wanted = np.array(sorted(col_by_offset), dtype=np.uint64)
+        out = {}
+        for row_id in self.row_ids():
+            if len(wanted) == 0:
+                break
+            base = np.uint64(row_id * SHARD_WIDTH)
+            offs = self.storage.slice_range(
+                int(base), int(base) + SHARD_WIDTH) - base
+            hits = wanted[np.isin(wanted, offs)]
+            if len(hits):
+                for off in hits:
+                    out[col_by_offset[int(off)]] = row_id
+                wanted = wanted[~np.isin(wanted, hits)]
+        return out
+
+    def contains(self, row_id, column_id):
+        return self.storage.contains(self.pos(row_id, column_id))
+
+    # -- BSI value ops (reference: fragment.go:896-1000) ---------------------
+
+    def value(self, column_id, bit_depth):
+        with self._lock:
+            if not self.contains(BSI_EXISTS_BIT, column_id):
+                return 0, False
+            value = 0
+            for i in range(bit_depth):
+                if self.contains(BSI_OFFSET_BIT + i, column_id):
+                    value |= 1 << i
+            if self.contains(BSI_SIGN_BIT, column_id):
+                value = -value
+            return value, True
+
+    def set_value(self, column_id, bit_depth, value):
+        """Sign-magnitude write of base-adjusted value; returns changed."""
+        to_set, to_clear = self.positions_for_value(column_id, bit_depth, value)
+        return self.import_positions(to_set, to_clear) > 0
+
+    def clear_value(self, column_id, bit_depth):
+        to_set, to_clear = self.positions_for_value(
+            column_id, bit_depth, 0, clear=True)
+        return self.import_positions(to_set, to_clear) > 0
+
+    def positions_for_value(self, column_id, bit_depth, value, clear=False):
+        to_set, to_clear = [], []
+        uvalue = abs(int(value))
+        # existence bit
+        (to_clear if clear else to_set).append(self.pos(BSI_EXISTS_BIT, column_id))
+        # sign bit
+        if value < 0 and not clear:
+            to_set.append(self.pos(BSI_SIGN_BIT, column_id))
+        else:
+            to_clear.append(self.pos(BSI_SIGN_BIT, column_id))
+        for i in range(bit_depth):
+            p = self.pos(BSI_OFFSET_BIT + i, column_id)
+            if (uvalue >> i) & 1:
+                to_set.append(p)
+            else:
+                to_clear.append(p)
+        return to_set, to_clear
+
+    # -- bulk ----------------------------------------------------------------
+
+    def import_positions(self, to_set, to_clear):
+        """Batched set/clear by raw position (reference: importPositions
+        fragment.go:2053). Returns changed count."""
+        with self._lock:
+            changed = 0
+            if len(to_set):
+                arr = np.asarray(to_set, dtype=np.uint64)
+                n = self.storage.add_many(arr)
+                if n:
+                    self._append_op(encode_op(OP_ADD_BATCH, values=arr))
+                    changed += n
+            if len(to_clear):
+                arr = np.asarray(to_clear, dtype=np.uint64)
+                n = self.storage.remove_many(arr)
+                if n:
+                    self._append_op(encode_op(OP_REMOVE_BATCH, values=arr))
+                    changed += n
+            if changed:
+                self._invalidate_all_rows()
+            return changed
+
+    def bulk_import(self, row_ids, column_ids, clear=False):
+        """Bulk bit import (reference: bulkImport fragment.go:1997). For
+        mutex fragments, each column keeps only its last-written row."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if self.mutexed:
+            return self._bulk_import_mutex(row_ids, column_ids)
+        positions = row_ids * np.uint64(SHARD_WIDTH) + (
+            column_ids % np.uint64(SHARD_WIDTH))
+        if clear:
+            return self.import_positions([], positions)
+        return self.import_positions(positions, [])
+
+    def _bulk_import_mutex(self, row_ids, column_ids):
+        with self._lock:
+            changed = 0
+            # last write per column wins (reference: bulkImportMutex)
+            last = {}
+            for r, c in zip(row_ids, column_ids):
+                last[int(c)] = int(r)
+            existing = self.rows_for_columns(list(last))
+            to_set, to_clear = [], []
+            for c, r in last.items():
+                old = existing.get(c)
+                if old == r:
+                    continue
+                if old is not None:
+                    to_clear.append(self.pos(old, c))
+                to_set.append(self.pos(r, c))
+            changed += self.import_positions(to_set, to_clear)
+            return changed
+
+    def import_roaring(self, data, clear=False):
+        """Merge a serialized roaring blob of positions — the fastest ingest
+        path (reference: importRoaring fragment.go:2255). Returns changed."""
+        other, _, _ = deserialize(data, with_ops=True)
+        with self._lock:
+            changed = merge_bitmaps(self.storage, other, clear=clear)
+            if changed:
+                op = OP_REMOVE_ROARING if clear else OP_ADD_ROARING
+                self._append_op(encode_op(op, roaring=serialize(other), op_n=changed))
+                self._invalidate_all_rows()
+            return changed
+
+    # -- row planes (the device path) ----------------------------------------
+
+    def row_plane(self, row_id):
+        """Host dense words for one row: containers
+        [row*CPS, (row+1)*CPS) (reference: rowFromStorage fragment.go:623
+        via OffsetRange)."""
+        return self.storage.dense_range_words(
+            row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD)
+
+    def row_device(self, row_id):
+        """Device plane for one row, cached until the row is written."""
+        import jax.numpy as jnp
+
+        cached = self._row_cache.get(row_id)
+        if cached is None:
+            cached = jnp.asarray(self.row_plane(row_id))
+            self._row_cache[row_id] = cached
+        return cached
+
+    def row_ids(self):
+        """Sorted rowIDs with any bit set (reference: fragment.rows)."""
+        return sorted({
+            key // CONTAINERS_PER_SHARD
+            for key in self.storage.keys()
+            if self.storage.containers[key].n > 0
+        })
+
+    def max_row_id(self):
+        ids = self.row_ids()
+        return ids[-1] if ids else 0
+
+    def row_columns(self, row_id):
+        """Absolute column ids of a row (host path, for result assembly)."""
+        base = row_id * SHARD_WIDTH
+        cols = self.storage.slice_range(base, base + SHARD_WIDTH)
+        return (cols - np.uint64(base)) + np.uint64(self.shard * SHARD_WIDTH)
+
+    def set_row_plane(self, row_id, plane_words):
+        """Overwrite a whole row from dense words (Store/ClearRow writes;
+        reference: fragment.setRow fragment.go:760). Returns True when the
+        stored row actually changed (bit-exact comparison)."""
+        plane_words = np.asarray(plane_words, dtype=np.uint32)
+        with self._lock:
+            old = self.row_plane(row_id)
+            if np.array_equal(old, plane_words):
+                return False
+            self.storage.replace_dense_words(
+                row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD,
+                plane_words)
+            # WAL: remove whole old row, add new row, as a roaring op pair.
+            row_bitmap = Bitmap()
+            row_bitmap.replace_dense_words(
+                row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD,
+                plane_words)
+            full = Bitmap()
+            full.merge_dense_words(
+                row_id * CONTAINERS_PER_SHARD,
+                np.full(CONTAINERS_PER_SHARD * WORDS_PER_CONTAINER, 0xFFFFFFFF,
+                        dtype=np.uint32))
+            self._append_op(encode_op(
+                OP_REMOVE_ROARING, roaring=serialize(full), op_n=0))
+            self._append_op(encode_op(
+                OP_ADD_ROARING, roaring=serialize(row_bitmap), op_n=0))
+            self._invalidate_row(row_id)
+            return True
+
+    # -- persistence ---------------------------------------------------------
+
+    def _append_op(self, op_bytes):
+        if self._file is not None:
+            self._file.write(op_bytes)
+            self._file.flush()
+        self.op_n += 1
+        if self.op_n > self.max_op_n:
+            if self.snapshot_queue is not None:
+                self.snapshot_queue.enqueue(self)
+            else:
+                self._snapshot_locked()
+
+    def snapshot(self):
+        with self._lock:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        """Rewrite the file without the op log (reference:
+        unprotectedWriteToFragment fragment.go:2347, temp+rename)."""
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            f.write(serialize(self.storage, flags=self.flags))
+        if self._file:
+            self._file.close()
+        os.replace(tmp, self.path)
+        self._file = open(self.path, "ab")
+        self.op_n = 0
+
+    # -- cache/invalidation ---------------------------------------------------
+
+    def _invalidate_row(self, row_id):
+        self._row_cache.pop(row_id, None)
+        self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self.generation += 1
+
+    def _invalidate_all_rows(self):
+        self._row_cache.clear()
+        self._checksums.clear()
+        self.generation += 1
+
+    # -- anti-entropy blocks (reference: Blocks fragment.go:1778) -------------
+
+    def blocks(self):
+        """[(block_id, checksum_bytes)] for every 100-row block with bits."""
+        out = []
+        with self._lock:
+            block_ids = sorted({r // HASH_BLOCK_SIZE for r in self.row_ids()})
+            for bid in block_ids:
+                chk = self._checksums.get(bid)
+                if chk is None:
+                    positions = self.storage.slice_range(
+                        bid * HASH_BLOCK_SIZE * SHARD_WIDTH,
+                        (bid + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH)
+                    if len(positions) == 0:
+                        continue
+                    chk = hashlib.blake2b(
+                        positions.astype("<u8").tobytes(), digest_size=16).digest()
+                    self._checksums[bid] = chk
+                out.append((bid, chk))
+        return out
+
+    def block_data(self, block_id):
+        """(row_ids, column_ids) pairs within a block (reference: blockData)."""
+        positions = self.storage.slice_range(
+            block_id * HASH_BLOCK_SIZE * SHARD_WIDTH,
+            (block_id + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH)
+        rows = positions // np.uint64(SHARD_WIDTH)
+        cols = positions % np.uint64(SHARD_WIDTH)
+        return rows, cols
+
+    # -- stats ----------------------------------------------------------------
+
+    def cardinality(self):
+        return self.storage.count()
+
+    def __repr__(self):
+        return (f"<Fragment {self.index}/{self.field}/{self.view}/"
+                f"{self.shard} n={self.storage.count()}>")

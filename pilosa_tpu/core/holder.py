@@ -1,0 +1,173 @@
+"""Holder: root container for all data on a node (reference: holder.go:50).
+
+Opens/closes indexes from the data directory, owns the snapshot queue (the
+background persister, reference: fragment.go:187-241), and exposes schema.
+"""
+
+import os
+import queue
+import shutil
+import threading
+
+from .field import FieldOptions
+from .index import Index, IndexOptions, validate_name
+
+
+class HolderError(Exception):
+    pass
+
+
+class SnapshotQueue:
+    """Single background worker persisting fragments whose op log exceeded
+    max_op_n (reference: newSnapshotQueue fragment.go:187). Bounded queue;
+    enqueue degrades to synchronous snapshot when full (the reference logs
+    and skips; synchronous is safer)."""
+
+    def __init__(self, size=100):
+        self._queue = queue.Queue(maxsize=size)
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._worker, name="snapshot-queue", daemon=True)
+        self._thread.start()
+        return self
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                frag = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                if frag.is_open and frag.op_n > 0:
+                    frag.snapshot()
+            except Exception:
+                pass
+            finally:
+                self._queue.task_done()
+
+    def enqueue(self, fragment):
+        try:
+            self._queue.put_nowait(fragment)
+        except queue.Full:
+            fragment.snapshot()
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._queue.join()
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+
+class Holder:
+    def __init__(self, path, max_op_n=None, use_snapshot_queue=True):
+        self.path = path
+        self.max_op_n = max_op_n
+        self.indexes = {}
+        self.snapshot_queue = SnapshotQueue() if use_snapshot_queue else None
+        self._lock = threading.RLock()
+        self.opened = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self):
+        """(reference: Holder.Open holder.go:137) Scan data dir and open
+        every index."""
+        os.makedirs(self.path, exist_ok=True)
+        if self.snapshot_queue:
+            self.snapshot_queue.start()
+        for name in sorted(os.listdir(self.path)):
+            sub = os.path.join(self.path, name)
+            if os.path.isdir(sub):
+                self._new_index(name).open()
+        self.opened = True
+        return self
+
+    def close(self):
+        with self._lock:
+            if self.snapshot_queue:
+                self.snapshot_queue.stop()
+            for idx in self.indexes.values():
+                idx.close()
+            self.indexes.clear()
+            self.opened = False
+
+    def reopen(self):
+        """Close and reopen from disk (test harness parity: test/pilosa.go:120)."""
+        self.close()
+        self.snapshot_queue = SnapshotQueue() if self.snapshot_queue is not None else None
+        return self.open()
+
+    # -- indexes ------------------------------------------------------------
+
+    def _new_index(self, name):
+        idx = Index(
+            os.path.join(self.path, name), name, max_op_n=self.max_op_n,
+            snapshot_queue=self.snapshot_queue)
+        self.indexes[name] = idx
+        return idx
+
+    def index(self, name):
+        return self.indexes.get(name)
+
+    def create_index(self, name, options=None, if_not_exists=False):
+        """(reference: Holder.CreateIndex holder.go:379)"""
+        validate_name(name)
+        with self._lock:
+            existing = self.indexes.get(name)
+            if existing is not None:
+                if if_not_exists:
+                    return existing
+                raise HolderError(f"index already exists: {name}")
+            idx = self._new_index(name)
+            idx.options = options or IndexOptions()
+            idx.open()
+            return idx
+
+    def delete_index(self, name):
+        with self._lock:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise HolderError(f"index not found: {name}")
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
+
+    # -- schema -------------------------------------------------------------
+
+    def schema(self):
+        """Serializable schema description (reference: Holder.Schema)."""
+        out = []
+        for iname in sorted(self.indexes):
+            idx = self.indexes[iname]
+            fields = []
+            for fname in sorted(idx.public_fields()):
+                f = idx.fields[fname]
+                fields.append({
+                    "name": fname,
+                    "options": f.options.to_dict(),
+                    "shards": f.available_shards(),
+                })
+            out.append({
+                "name": iname,
+                "options": idx.options.to_dict(),
+                "fields": fields,
+            })
+        return out
+
+    def apply_schema(self, schema):
+        """Create any missing indexes/fields from a schema description
+        (cluster DDL sync; reference: api.ApplySchema/holder merge)."""
+        for idx_desc in schema:
+            idx = self.create_index(
+                idx_desc["name"],
+                options=IndexOptions.from_dict(idx_desc.get("options", {})),
+                if_not_exists=True)
+            for f_desc in idx_desc.get("fields", []):
+                idx.create_field(
+                    f_desc["name"],
+                    options=FieldOptions.from_dict(f_desc.get("options", {})),
+                    if_not_exists=True)

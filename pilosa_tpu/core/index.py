@@ -1,0 +1,161 @@
+"""Index: a namespace of fields over a shared column space.
+
+Reference: index.go:37. Holds fields, column attributes, the optional
+`_exists` existence field used by Not() queries (track_existence;
+reference: index.go:215, holder.go:46), and the column-keys option.
+"""
+
+import json
+import os
+import re
+import threading
+
+from .field import Field, FieldOptions
+
+EXISTENCE_FIELD_NAME = "_exists"  # reference: holder.go:46
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")  # reference: pilosa.go:121
+
+
+class IndexError_(Exception):
+    pass
+
+
+def validate_name(name):
+    if not _NAME_RE.match(name):
+        raise IndexError_(
+            f"invalid name {name!r}: must match [a-z][a-z0-9_-]{{0,63}}")
+    return name
+
+
+class IndexOptions:
+    def __init__(self, keys=False, track_existence=True):
+        self.keys = keys
+        self.track_existence = track_existence
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+class Index:
+    def __init__(self, path, name, options=None, max_op_n=None,
+                 snapshot_queue=None, column_attr_store=None,
+                 row_attr_stores=None):
+        self.path = path
+        self.name = name
+        self.options = options or IndexOptions()
+        self.max_op_n = max_op_n
+        self.snapshot_queue = snapshot_queue
+        self.fields = {}
+        self.column_attr_store = column_attr_store
+        self._row_attr_stores = row_attr_stores or {}
+        self._lock = threading.RLock()
+
+    @property
+    def meta_path(self):
+        return os.path.join(self.path, ".meta")
+
+    @property
+    def keys(self):
+        return self.options.keys
+
+    def open(self):
+        os.makedirs(self.path, exist_ok=True)
+        if os.path.exists(self.meta_path):
+            with open(self.meta_path) as f:
+                self.options = IndexOptions.from_dict(json.load(f))
+        else:
+            self.save_meta()
+        for name in sorted(os.listdir(self.path)):
+            sub = os.path.join(self.path, name)
+            if os.path.isdir(sub) and os.path.exists(os.path.join(sub, ".meta")):
+                self._new_field(name).open()
+        if self.options.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
+            self._create_existence_field()
+        return self
+
+    def save_meta(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self.meta_path, "w") as f:
+            json.dump(self.options.to_dict(), f)
+
+    def close(self):
+        with self._lock:
+            for f in self.fields.values():
+                f.close()
+            self.fields.clear()
+
+    # -- fields -------------------------------------------------------------
+
+    def _new_field(self, name, options=None):
+        field = Field(
+            os.path.join(self.path, name), self.name, name, options=options,
+            max_op_n=self.max_op_n, snapshot_queue=self.snapshot_queue,
+            row_attr_store=self._row_attr_stores.get(name))
+        self.fields[name] = field
+        return field
+
+    def _create_existence_field(self):
+        field = self._new_field(EXISTENCE_FIELD_NAME, FieldOptions(
+            cache_type="none", cache_size=0))
+        field.open()
+        return field
+
+    def field(self, name):
+        return self.fields.get(name)
+
+    def existence_field(self):
+        return self.fields.get(EXISTENCE_FIELD_NAME)
+
+    def create_field(self, name, options=None, if_not_exists=False):
+        """(reference: Index.CreateField index.go:351)"""
+        validate_name(name)
+        with self._lock:
+            existing = self.fields.get(name)
+            if existing is not None:
+                if if_not_exists:
+                    return existing
+                raise IndexError_(f"field already exists: {name}")
+            field = self._new_field(name, options or FieldOptions())
+            field.open()
+            return field
+
+    def delete_field(self, name):
+        import shutil
+
+        with self._lock:
+            field = self.fields.pop(name, None)
+            if field is None:
+                raise IndexError_(f"field not found: {name}")
+            field.close()
+            shutil.rmtree(field.path, ignore_errors=True)
+
+    def public_fields(self):
+        return {n: f for n, f in self.fields.items()
+                if n != EXISTENCE_FIELD_NAME}
+
+    # -- shards -------------------------------------------------------------
+
+    def available_shards(self):
+        """(reference: Index.AvailableShards index.go:292)"""
+        shards = set()
+        for f in self.fields.values():
+            shards.update(f.available_shards())
+        return sorted(shards)
+
+    # -- existence tracking --------------------------------------------------
+
+    def add_existence(self, column_ids):
+        if not self.options.track_existence:
+            return
+        field = self.existence_field()
+        if field is None:
+            field = self._create_existence_field()
+        import numpy as np
+
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        field.import_bits(np.zeros(len(column_ids), dtype=np.uint64), column_ids)

@@ -1,0 +1,87 @@
+"""Row: a cross-shard query result (reference: row.go:27).
+
+The reference's Row is a list of per-shard rowSegments each wrapping a
+roaring bitmap, merged during reduce. Here a Row holds per-shard dense
+planes (host numpy; device arrays live only inside the executor's jitted
+call trees) plus optional attrs/keys decoration for responses.
+"""
+
+import numpy as np
+
+from ..shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+from ..roaring.containers import popcount32
+
+
+class Row:
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, segments=None):
+        # shard -> [WORDS_PER_ROW] uint32 plane
+        self.segments = segments or {}
+        self.attrs = None
+        self.keys = None
+
+    @classmethod
+    def from_columns(cls, columns):
+        """Build from absolute column ids (test/import convenience)."""
+        columns = np.asarray(columns, dtype=np.uint64)
+        row = cls()
+        shards = columns // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards):
+            offs = (columns[shards == shard] % np.uint64(SHARD_WIDTH)).astype(np.int64)
+            plane = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+            np.bitwise_or.at(
+                plane, offs // 32, np.uint32(1) << (offs % 32).astype(np.uint32))
+            row.segments[int(shard)] = plane
+        return row
+
+    def merge(self, other):
+        """Union-merge segments from another Row (reference: Row.Merge
+        row.go:67)."""
+        for shard, plane in other.segments.items():
+            mine = self.segments.get(shard)
+            if mine is None:
+                self.segments[shard] = plane
+            else:
+                self.segments[shard] = mine | plane
+        return self
+
+    def count(self):
+        return int(sum(
+            int(popcount32(p).sum()) for p in self.segments.values()))
+
+    def any(self):
+        return any(p.any() for p in self.segments.values())
+
+    def columns(self):
+        """Sorted absolute column ids."""
+        out = []
+        for shard in sorted(self.segments):
+            plane = self.segments[shard]
+            nz = np.nonzero(plane)[0]
+            if len(nz) == 0:
+                continue
+            bits = np.unpackbits(
+                plane[nz].view(np.uint8).reshape(-1, 4), axis=1,
+                bitorder="little")
+            w, b = np.nonzero(bits)
+            out.append(nz[w].astype(np.uint64) * 32 + b.astype(np.uint64)
+                       + np.uint64(shard * SHARD_WIDTH))
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def shards(self):
+        return sorted(self.segments)
+
+    def __eq__(self, other):
+        if not isinstance(other, Row):
+            return NotImplemented
+        mine = {s: p for s, p in self.segments.items() if p.any()}
+        theirs = {s: p for s, p in other.segments.items() if p.any()}
+        if mine.keys() != theirs.keys():
+            return False
+        return all(np.array_equal(mine[s], theirs[s]) for s in mine)
+
+    def __repr__(self):
+        return f"<Row count={self.count()} shards={self.shards()}>"

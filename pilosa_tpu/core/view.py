@@ -1,0 +1,118 @@
+"""View: a layout variant of a field, grouping per-shard fragments.
+
+Reference: view.go:44. Names: "standard", time-quantum views
+("standard_2019", ...), and "bsig_<field>" for BSI integer storage
+(view.go:27-41).
+"""
+
+import os
+import threading
+
+from .fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_GROUP_PREFIX = "bsig_"
+
+
+class View:
+    def __init__(self, path, index, field, name, max_op_n=None,
+                 snapshot_queue=None, mutexed=False):
+        self.path = path  # .../<field>/views/<name>
+        self.index = index
+        self.field = field
+        self.name = name
+        self.mutexed = mutexed
+        self.max_op_n = max_op_n
+        self.snapshot_queue = snapshot_queue
+        self.fragments = {}  # shard -> Fragment
+        self._lock = threading.RLock()
+
+    def open(self):
+        frag_dir = os.path.join(self.path, "fragments")
+        os.makedirs(frag_dir, exist_ok=True)
+        for name in sorted(os.listdir(frag_dir)):
+            if name.endswith(".snapshotting") or name.endswith(".cache"):
+                continue
+            try:
+                shard = int(name)
+            except ValueError:
+                continue
+            self._new_fragment(shard).open()
+        return self
+
+    def close(self):
+        with self._lock:
+            for f in self.fragments.values():
+                f.close()
+            self.fragments.clear()
+
+    def fragment_path(self, shard):
+        return os.path.join(self.path, "fragments", str(shard))
+
+    def _new_fragment(self, shard):
+        kwargs = {}
+        if self.max_op_n is not None:
+            kwargs["max_op_n"] = self.max_op_n
+        frag = Fragment(
+            self.fragment_path(shard), self.index, self.field, self.name,
+            shard, snapshot_queue=self.snapshot_queue, mutexed=self.mutexed,
+            **kwargs)
+        self.fragments[shard] = frag
+        return frag
+
+    def fragment(self, shard):
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard):
+        """(reference: view.CreateFragmentIfNotExists view.go:263)"""
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag = self._new_fragment(shard)
+                frag.open()
+            return frag
+
+    def available_shards(self):
+        return sorted(self.fragments.keys())
+
+    # -- routed ops ---------------------------------------------------------
+
+    def set_bit(self, row_id, column_id):
+        from ..shardwidth import SHARD_WIDTH
+
+        shard = column_id // SHARD_WIDTH
+        return self.create_fragment_if_not_exists(shard).set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id, column_id):
+        from ..shardwidth import SHARD_WIDTH
+
+        shard = column_id // SHARD_WIDTH
+        frag = self.fragment(shard)
+        if frag is None:
+            return False
+        return frag.clear_bit(row_id, column_id)
+
+    def set_value(self, column_id, bit_depth, value):
+        from ..shardwidth import SHARD_WIDTH
+
+        shard = column_id // SHARD_WIDTH
+        return self.create_fragment_if_not_exists(shard).set_value(
+            column_id, bit_depth, value)
+
+    def clear_value(self, column_id, bit_depth):
+        from ..shardwidth import SHARD_WIDTH
+
+        shard = column_id // SHARD_WIDTH
+        frag = self.fragment(shard)
+        if frag is None:
+            return False
+        return frag.clear_value(column_id, bit_depth)
+
+    def value(self, column_id, bit_depth):
+        from ..shardwidth import SHARD_WIDTH
+
+        shard = column_id // SHARD_WIDTH
+        frag = self.fragment(shard)
+        if frag is None:
+            return 0, False
+        return frag.value(column_id, bit_depth)

@@ -154,19 +154,24 @@ def _deserialize_pilosa(data, with_ops):
 
 
 def _read_container(data, offset, typ, n):
-    if typ == TYPE_ARRAY:
-        end = offset + 2 * n
-        values = np.frombuffer(data, dtype="<u2", count=n, offset=offset).copy()
-        return Container(TYPE_ARRAY, values=values, n=n), end
-    if typ == TYPE_BITMAP:
-        end = offset + BITMAP_BYTES
-        words = np.frombuffer(data, dtype="<u4", count=BITMAP_BYTES // 4, offset=offset).copy()
-        return Container(TYPE_BITMAP, words=words, n=n), end
-    if typ == TYPE_RUN:
-        run_count = struct.unpack_from("<H", data, offset)[0]
-        end = offset + 2 + 4 * run_count
-        runs = np.frombuffer(data, dtype="<u2", count=run_count * 2, offset=offset + 2)
-        return Container(TYPE_RUN, runs=runs.reshape(-1, 2).copy(), n=n), end
+    try:
+        if typ == TYPE_ARRAY:
+            end = offset + 2 * n
+            values = np.frombuffer(data, dtype="<u2", count=n, offset=offset).copy()
+            return Container(TYPE_ARRAY, values=values, n=n), end
+        if typ == TYPE_BITMAP:
+            end = offset + BITMAP_BYTES
+            words = np.frombuffer(
+                data, dtype="<u4", count=BITMAP_BYTES // 4, offset=offset).copy()
+            return Container(TYPE_BITMAP, words=words, n=n), end
+        if typ == TYPE_RUN:
+            run_count = struct.unpack_from("<H", data, offset)[0]
+            end = offset + 2 + 4 * run_count
+            runs = np.frombuffer(
+                data, dtype="<u2", count=run_count * 2, offset=offset + 2)
+            return Container(TYPE_RUN, runs=runs.reshape(-1, 2).copy(), n=n), end
+    except (ValueError, struct.error) as e:
+        raise FormatError(f"truncated container payload at {offset}: {e}") from e
     raise FormatError(f"unknown container type {typ}")
 
 
@@ -189,12 +194,21 @@ def _deserialize_official(data):
         pos += 4
         headers.append((key, card_minus_1 + 1))
 
-    # Offset section present only in the no-runs variant (the reference
-    # ignores it and walks sequentially either way; we do the same).
-    if run_flags is None:
+    # Offset section: always present in the no-runs variant; in the runs
+    # variant the official spec writes it when there are >= 4 containers
+    # (NO_OFFSET_THRESHOLD). Payloads are walked sequentially either way.
+    if run_flags is None or n_keys >= 4:
         pos += 4 * n_keys
 
     b = Bitmap()
+    try:
+        _read_official_payloads(b, data, pos, headers, run_flags)
+    except (ValueError, struct.error) as e:
+        raise FormatError(f"truncated official container payload: {e}") from e
+    return b, pos
+
+
+def _read_official_payloads(b, data, pos, headers, run_flags):
     for i, (key, n) in enumerate(headers):
         is_run = run_flags is not None and (run_flags[i // 8] >> (i % 8)) & 1
         if is_run:

@@ -53,10 +53,9 @@ class Container:
 
     @classmethod
     def from_values(cls, values):
-        values = np.asarray(values, dtype=np.uint16)
+        values = np.unique(np.asarray(values, dtype=np.uint16))  # sorted+dedup
         if len(values) > ARRAY_MAX_SIZE:
-            c = cls.from_dense_words(values_to_words(values))
-            return c
+            return cls.from_dense_words(values_to_words(values))
         return cls(TYPE_ARRAY, values=values)
 
     @classmethod
@@ -185,14 +184,11 @@ class Container:
         """[2048] uint32 dense words (shared buffer for bitmap containers)."""
         if self.typ == TYPE_BITMAP:
             return self.words
-        words = np.zeros(WORDS, dtype=np.uint32)
         if self.typ == TYPE_ARRAY:
-            if len(self.values):
-                v = self.values.astype(np.uint32)
-                np.bitwise_or.at(words, v >> 5, np.uint32(1) << (v & np.uint32(31)))
-        else:
-            for s, l in self.runs:
-                _fill_run(words, int(s), int(l))
+            return values_to_words(self.values)
+        words = np.zeros(WORDS, dtype=np.uint32)
+        for s, l in self.runs:
+            _fill_run(words, int(s), int(l))
         return words
 
     def to_values(self):
