@@ -212,6 +212,8 @@ class Field:
         return changed
 
     def clear_bit(self, row_id, column_id):
+        if self.type == FIELD_TYPE_INT:
+            raise FieldError(f"clear_bit unsupported for field type {self.type}")
         changed = False
         for name, view in list(self.views.items()):
             if name.startswith(VIEW_BSI_GROUP_PREFIX):

@@ -72,6 +72,8 @@ class Fragment:
         self.op_n = 0
         self.flags = 0
         self._file = None
+        self._snapshot_pending = False
+        self._row_ids_cache = None
         self._lock = threading.RLock()
 
         # Device plane cache: rowID -> jax array; bumped generation
@@ -99,7 +101,8 @@ class Fragment:
                 # section (the reference's file is likewise snapshot ++ ops).
                 with open(self.path, "wb") as f:
                     f.write(serialize(self.storage, flags=self.flags))
-            self._file = open(self.path, "ab")
+            if self._file is None:  # _snapshot_locked may have opened it
+                self._file = open(self.path, "ab")
         return self
 
     def close(self):
@@ -258,7 +261,9 @@ class Fragment:
         mutex fragments, each column keeps only its last-written row."""
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
-        if self.mutexed:
+        if self.mutexed and not clear:
+            # Clears don't need last-write-wins resolution (reference:
+            # bulkImport takes the mutex path only when !options.Clear).
             return self._bulk_import_mutex(row_ids, column_ids)
         positions = row_ids * np.uint64(SHARD_WIDTH) + (
             column_ids % np.uint64(SHARD_WIDTH))
@@ -317,12 +322,18 @@ class Fragment:
         return cached
 
     def row_ids(self):
-        """Sorted rowIDs with any bit set (reference: fragment.rows)."""
-        return sorted({
+        """Sorted rowIDs with any bit set (reference: fragment.rows),
+        memoized per write-generation (mutex set_bit probes this per write)."""
+        cached = self._row_ids_cache
+        if cached is not None and cached[0] == self.generation:
+            return cached[1]
+        ids = sorted({
             key // CONTAINERS_PER_SHARD
             for key in self.storage.keys()
             if self.storage.containers[key].n > 0
         })
+        self._row_ids_cache = (self.generation, ids)
+        return ids
 
     def max_row_id(self):
         ids = self.row_ids()
@@ -372,7 +383,9 @@ class Fragment:
         self.op_n += 1
         if self.op_n > self.max_op_n:
             if self.snapshot_queue is not None:
-                self.snapshot_queue.enqueue(self)
+                if not self._snapshot_pending:
+                    self._snapshot_pending = True
+                    self.snapshot_queue.enqueue(self)
             else:
                 self._snapshot_locked()
 
@@ -391,6 +404,7 @@ class Fragment:
         os.replace(tmp, self.path)
         self._file = open(self.path, "ab")
         self.op_n = 0
+        self._snapshot_pending = False
 
     # -- cache/invalidation ---------------------------------------------------
 

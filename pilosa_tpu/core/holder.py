@@ -4,6 +4,7 @@ Opens/closes indexes from the data directory, owns the snapshot queue (the
 background persister, reference: fragment.go:187-241), and exposes schema.
 """
 
+import logging
 import os
 import queue
 import shutil
@@ -44,7 +45,8 @@ class SnapshotQueue:
                 if frag.is_open and frag.op_n > 0:
                     frag.snapshot()
             except Exception:
-                pass
+                logging.getLogger("pilosa_tpu").exception(
+                    "snapshot failed for %r", frag)
             finally:
                 self._queue.task_done()
 

@@ -1,0 +1,16 @@
+"""PQL: the Pilosa Query Language (reference: pql/)."""
+
+from .ast import (
+    BETWEEN,
+    Call,
+    Condition,
+    EQ,
+    GT,
+    GTE,
+    LT,
+    LTE,
+    NEQ,
+    Query,
+    is_reserved_arg,
+)
+from .parser import ParseError, parse

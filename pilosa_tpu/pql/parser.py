@@ -1,0 +1,462 @@
+"""Recursive-descent PQL parser.
+
+A hand-written port of the reference grammar (pql/pql.peg) — the reference
+generates a packrat parser with pointlander/peg; the grammar is small enough
+that direct recursive descent is clearer and faster in Python.
+
+Grammar summary (reference: pql/pql.peg:8-83):
+  Calls  <- sp (Call sp)* !.
+  Call   <- special forms (Set/SetRowAttrs/SetColumnAttrs/Clear/ClearRow/
+            Store/TopN/Rows/Range) / IDENT '(' allargs ','? ')'
+  allargs<- Call (',' Call)* (',' args)? / args / sp
+  arg    <- field '=' value / field COND value / conditional
+  conditional <- int (<|<=) field (<|<=) int      -> BETWEEN
+  value  <- null/true/false/timestamp/number/nested Call/word/quoted
+"""
+
+import re
+
+from .ast import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, Query
+
+
+class ParseError(Exception):
+    def __init__(self, message, pos, src):
+        line = src.count("\n", 0, pos) + 1
+        col = pos - (src.rfind("\n", 0, pos) + 1) + 1
+        super().__init__(f"parse error at line {line}, col {col}: {message}")
+        self.pos = pos
+
+
+_IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
+_FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
+_RESERVED_FIELD_RE = re.compile(r"_row|_col|_start|_end|_timestamp|_field")
+_UINT_RE = re.compile(r"[1-9][0-9]*|0")
+_INT_RE = re.compile(r"-?(?:[1-9][0-9]*|0)")
+_NUMBER_RE = re.compile(r"-?(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+)")
+_WORD_RE = re.compile(r"[A-Za-z0-9\-_:]+")
+_TIMESTAMP_RE = re.compile(r"[0-9]{4}-[01][0-9]-[0-3][0-9]T[0-9]{2}:[0-9]{2}")
+_COND_RE = re.compile(r"><|<=|>=|==|!=|<|>")
+_COND_TOKEN = {"><": BETWEEN, "<=": LTE, ">=": GTE, "==": EQ,
+               "!=": NEQ, "<": LT, ">": GT}
+
+
+def parse(src):
+    """Parse a PQL string into a Query (reference: pql.ParseString)."""
+    return _Parser(src).parse_query()
+
+
+class _Parser:
+    def __init__(self, src):
+        self.src = src
+        self.pos = 0
+        self.n = len(src)
+
+    # -- low-level ----------------------------------------------------------
+
+    def error(self, message):
+        raise ParseError(message, self.pos, self.src)
+
+    def sp(self):
+        while self.pos < self.n and self.src[self.pos] in " \t\n\r":
+            self.pos += 1
+
+    def eof(self):
+        return self.pos >= self.n
+
+    def peek(self, s):
+        return self.src.startswith(s, self.pos)
+
+    def accept(self, s):
+        if self.peek(s):
+            self.pos += len(s)
+            return True
+        return False
+
+    def expect(self, s, what=None):
+        if not self.accept(s):
+            self.error(f"expected {what or s!r}")
+
+    def match(self, regex):
+        m = regex.match(self.src, self.pos)
+        if m:
+            self.pos = m.end()
+            return m.group(0)
+        return None
+
+    def comma(self):
+        self.sp()
+        ok = self.accept(",")
+        self.sp()
+        return ok
+
+    def expect_comma(self):
+        if not self.comma():
+            self.error("expected ','")
+
+    def open(self):
+        self.expect("(")
+        self.sp()
+
+    def close(self):
+        self.sp()
+        self.expect(")")
+        self.sp()
+
+    # -- query/call ---------------------------------------------------------
+
+    def parse_query(self):
+        calls = []
+        self.sp()
+        while not self.eof():
+            calls.append(self.parse_call())
+            self.sp()
+        return Query(calls)
+
+    def parse_call(self):
+        start = self.pos
+        name = self.match(_IDENT_RE)
+        if name is None:
+            self.error("expected call name")
+        special = getattr(self, f"_parse_{name}", None)
+        if special is not None:
+            return special()
+        call = Call(name)
+        self.open()
+        self._parse_allargs(call)
+        self.comma()  # trailing comma allowed
+        self.close()
+        return call
+
+    # -- special forms ------------------------------------------------------
+
+    def _parse_Set(self):
+        call = Call("Set")
+        self.open()
+        self._parse_col(call)
+        self.expect_comma()
+        self._parse_args(call)
+        save = self.pos
+        if self.comma():
+            ts = self._parse_timestampfmt()
+            if ts is None:
+                self.pos = save
+            else:
+                call.args["_timestamp"] = ts
+        self.close()
+        return call
+
+    def _parse_SetRowAttrs(self):
+        call = Call("SetRowAttrs")
+        self.open()
+        self._parse_posfield(call)
+        self.expect_comma()
+        self._parse_row(call)
+        self.expect_comma()
+        self._parse_args(call)
+        self.close()
+        return call
+
+    def _parse_SetColumnAttrs(self):
+        call = Call("SetColumnAttrs")
+        self.open()
+        self._parse_col(call)
+        self.expect_comma()
+        self._parse_args(call)
+        self.close()
+        return call
+
+    def _parse_Clear(self):
+        call = Call("Clear")
+        self.open()
+        self._parse_col(call)
+        self.expect_comma()
+        self._parse_args(call)
+        self.close()
+        return call
+
+    def _parse_ClearRow(self):
+        call = Call("ClearRow")
+        self.open()
+        self._parse_arg(call)
+        self.close()
+        return call
+
+    def _parse_Store(self):
+        call = Call("Store")
+        self.open()
+        call.children.append(self.parse_call())
+        self.expect_comma()
+        self._parse_arg(call)
+        self.close()
+        return call
+
+    def _parse_TopN(self):
+        return self._posfield_call("TopN")
+
+    def _parse_Rows(self):
+        return self._posfield_call("Rows")
+
+    def _posfield_call(self, name):
+        call = Call(name)
+        self.open()
+        self._parse_posfield(call)
+        save = self.pos
+        if self.comma():
+            if self.peek(")"):
+                self.pos = save
+            else:
+                self._parse_allargs(call)
+        self.close()
+        return call
+
+    def _parse_Range(self):
+        # Deprecated Range(field=value, from=ts, to=ts) form; Range(Row...)
+        # and Range(field >< ...) go through the generic path.
+        save = self.pos
+        call = Call("Range")
+        self.open()
+        field = self.match(_FIELD_RE)
+        self.sp()
+        if field is not None and self.accept("="):
+            self.sp()
+            val = self._parse_value()
+            call.args[field] = val
+            if self.comma():
+                self.accept("from=")
+                call.args["from"] = self._require_timestampfmt()
+                self.expect_comma()
+                self.accept("to=")
+                self.sp()
+                call.args["to"] = self._require_timestampfmt()
+                self.close()
+                return call
+        # fall back to generic parse
+        self.pos = save
+        call = Call("Range")
+        self.open()
+        self._parse_allargs(call)
+        self.comma()
+        self.close()
+        return call
+
+    # -- args ---------------------------------------------------------------
+
+    def _parse_allargs(self, call):
+        self.sp()
+        if self.peek(")"):
+            return
+        # Call (comma Call)* (comma args)?
+        if self._at_call():
+            call.children.append(self.parse_call())
+            while True:
+                save = self.pos
+                if not self.comma():
+                    break
+                if self._at_call():
+                    call.children.append(self.parse_call())
+                elif self.peek(")"):
+                    self.pos = save
+                    break
+                else:
+                    self._parse_args(call)
+                    break
+            return
+        self._parse_args(call)
+
+    def _at_call(self):
+        """Lookahead: IDENT '(' means nested call, not an arg."""
+        m = _IDENT_RE.match(self.src, self.pos)
+        if not m:
+            return False
+        rest = self.src[m.end():m.end() + 16]
+        return rest.lstrip(" \t\n").startswith("(")
+
+    def _parse_args(self, call):
+        self._parse_arg(call)
+        while True:
+            save = self.pos
+            if not self.comma():
+                break
+            try:
+                self._parse_arg(call)
+            except ParseError:
+                # PEG backtracking: `args <- arg (comma args)?` — a comma
+                # followed by a non-arg (Set's trailing timestamp, trailing
+                # comma before ')') belongs to the enclosing rule.
+                self.pos = save
+                break
+        self.sp()
+
+    def _parse_arg(self, call):
+        # conditional: int condLT field condLT int
+        save = self.pos
+        low = self.match(_INT_RE)
+        if low is not None:
+            self.sp()
+            op1 = self.accept("<=") and "<=" or (self.accept("<") and "<")
+            if op1:
+                self.sp()
+                field = self.match(_FIELD_RE)
+                if field is not None:
+                    self.sp()
+                    op2 = self.accept("<=") and "<=" or (self.accept("<") and "<")
+                    if op2:
+                        self.sp()
+                        high = self.match(_INT_RE)
+                        if high is not None:
+                            lo, hi = int(low), int(high)
+                            if op1 == "<":
+                                lo += 1
+                            if op2 == "<":
+                                hi -= 1
+                            self._set_arg(call, field,
+                                          Condition(BETWEEN, [lo, hi]))
+                            return
+            self.pos = save
+
+        field = self.match(_FIELD_RE) or self.match(_RESERVED_FIELD_RE)
+        if field is None:
+            self.error("expected argument name")
+        self.sp()
+        cond = self.match(_COND_RE)  # before '=': '==' must not half-match
+        if cond is not None:
+            self.sp()
+            value = self._parse_value()
+            self._set_arg(call, field, Condition(_COND_TOKEN[cond], value))
+            return
+        if self.accept("="):
+            self.sp()
+            self._set_arg(call, field, self._parse_value())
+            return
+        self.error("expected '=' or comparison operator")
+
+    def _set_arg(self, call, field, value):
+        if field in call.args:
+            self.error(f"duplicate argument provided: {field}")
+        call.args[field] = value
+
+    # -- values -------------------------------------------------------------
+
+    def _parse_value(self):
+        if self.accept("["):
+            self.sp()
+            items = []
+            if not self.peek("]"):
+                while True:
+                    items.append(self._parse_item())
+                    if not self.comma():
+                        break
+            self.sp()
+            self.expect("]")
+            self.sp()
+            return items
+        return self._parse_item()
+
+    def _boundary_follows(self):
+        i = self.pos
+        while i < self.n and self.src[i] in " \t\n":
+            i += 1
+        return i >= self.n or self.src[i] in ",)]"
+
+    def _parse_item(self):
+        for lit, value in (("null", None), ("true", True), ("false", False)):
+            if self.peek(lit):
+                save = self.pos
+                self.pos += len(lit)
+                if self._boundary_follows():
+                    return value
+                self.pos = save
+
+        ts = self._parse_timestampfmt()
+        if ts is not None:
+            return ts
+
+        save = self.pos
+        num = self.match(_NUMBER_RE)
+        if num is not None:
+            # words like 123abc must not half-match as numbers
+            if self._boundary_follows() or not _WORD_RE.match(self.src, self.pos):
+                if "." in num:
+                    return float(num)
+                return int(num)
+            self.pos = save
+
+        if self._at_call():
+            return self.parse_call()
+
+        word = self.match(_WORD_RE)
+        if word is not None:
+            return word
+
+        if self.accept('"'):
+            return self._quoted('"')
+        if self.accept("'"):
+            return self._quoted("'")
+        self.error("expected value")
+
+    def _quoted(self, quote):
+        out = []
+        while self.pos < self.n:
+            ch = self.src[self.pos]
+            if ch == "\\" and self.pos + 1 < self.n:
+                nxt = self.src[self.pos + 1]
+                if nxt in (quote, "\\"):
+                    out.append(nxt)
+                    self.pos += 2
+                    continue
+            if ch == quote:
+                self.pos += 1
+                return "".join(out)
+            out.append(ch)
+            self.pos += 1
+        self.error("unterminated string")
+
+    def _parse_timestampfmt(self):
+        for quote in ('"', "'", ""):
+            save = self.pos
+            if quote and not self.accept(quote):
+                continue
+            ts = self.match(_TIMESTAMP_RE)
+            if ts is not None:
+                if quote:
+                    if self.accept(quote):
+                        return ts
+                elif self._boundary_follows():
+                    return ts
+            self.pos = save
+        return None
+
+    def _require_timestampfmt(self):
+        ts = self._parse_timestampfmt()
+        if ts is None:
+            self.error("expected timestamp (YYYY-MM-DDTHH:MM)")
+        return ts
+
+    # -- positional fields --------------------------------------------------
+
+    def _parse_posfield(self, call):
+        name = self.match(_FIELD_RE)
+        if name is None:
+            self.error("expected field name")
+        call.args["_field"] = name
+        self.sp()
+
+    def _parse_col(self, call):
+        self._parse_pos(call, "_col")
+
+    def _parse_row(self, call):
+        self._parse_pos(call, "_row")
+
+    def _parse_pos(self, call, key):
+        num = self.match(_UINT_RE)
+        if num is not None:
+            call.args[key] = int(num)
+            self.sp()
+            return
+        if self.accept("'"):
+            call.args[key] = self._quoted("'")
+        elif self.accept('"'):
+            call.args[key] = self._quoted('"')
+        else:
+            self.error(f"expected column/row id or quoted key")
+        self.sp()

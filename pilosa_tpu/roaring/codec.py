@@ -202,7 +202,7 @@ def _deserialize_official(data):
 
     b = Bitmap()
     try:
-        _read_official_payloads(b, data, pos, headers, run_flags)
+        _, pos = _read_official_payloads(b, data, pos, headers, run_flags)
     except (ValueError, struct.error) as e:
         raise FormatError(f"truncated official container payload: {e}") from e
     return b, pos

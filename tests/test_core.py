@@ -349,3 +349,22 @@ def test_row_merge_count_columns():
     assert r1.count() == 4
     assert list(r1.columns()) == [1, 5, SHARD_WIDTH + 3, 2 * SHARD_WIDTH + 7]
     assert r1 == Row.from_columns([1, 5, SHARD_WIDTH + 3, 2 * SHARD_WIDTH + 7])
+
+
+def test_mutex_bulk_clear(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0,
+                 mutexed=True).open()
+    f.set_bit(3, 50)
+    f.bulk_import([3], [50], clear=True)
+    assert not f.contains(3, 50)
+    # clear of an unset bit must not set it
+    f.bulk_import([9], [60], clear=True)
+    assert not f.contains(9, 60)
+    f.close()
+
+
+def test_clear_bit_on_int_field_raises(holder):
+    idx = holder.create_index("i")
+    fld = idx.create_field("n", FieldOptions.int_field(min=0, max=10))
+    with pytest.raises(Exception):
+        fld.clear_bit(0, 1)
