@@ -1,0 +1,4 @@
+"""Query engine (reference: executor.go)."""
+
+from .executor import ExecError, ExecOptions, Executor, FieldNotFound
+from .result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount

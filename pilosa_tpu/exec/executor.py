@@ -1,0 +1,910 @@
+"""PQL executor: per-shard device evaluation + cross-shard reduce.
+
+Reference: executor.go (Execute :113, executeCall :274, per-shard map fns
+:651-1789, mapReduce :2455). The TPU-native redesign:
+
+- Every bitmap call tree evaluates per shard as a chain of device-plane ops
+  (pilosa_tpu.ops). Planes are lazily-uploaded, cached fragment rows; ops
+  dispatch asynchronously, so an entire call tree becomes one fused stream
+  of XLA elementwise kernels with NO host sync until the final reduce.
+- Scalar reduces (Count/Sum/Min/Max/TopN counts) stay on device as 0-d
+  arrays; the executor stacks them and syncs ONCE per query.
+- Cross-shard reduce runs on host (sums/merges), mirroring the reference's
+  mapReduce tree but with shard-batched device work (the multi-device path
+  in pilosa_tpu.parallel shard-maps the same evaluation over a mesh).
+
+Aggregate semantics (baseValue clamping, notNull fast paths, sign handling)
+follow the reference exactly: executeRowBSIGroupShard executor.go:1533,
+bsiGroup.baseValue field.go:1583.
+"""
+
+import numpy as np
+
+from ..core.field import FIELD_TYPE_INT, FIELD_TYPE_TIME
+from ..core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
+from ..core.row import Row
+from ..core import timeq
+from ..core.view import VIEW_STANDARD
+from ..pql import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, parse
+from ..shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+from .result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount
+
+_TOPN_STACK_CHUNK = 256  # rows per stacked device batch
+
+
+class ExecError(Exception):
+    pass
+
+
+class FieldNotFound(ExecError):
+    pass
+
+
+class ExecOptions:
+    def __init__(self, shards=None, exclude_columns=False,
+                 column_attrs=False, exclude_row_attrs=False, remote=False,
+                 profile=False):
+        self.shards = shards
+        self.exclude_columns = exclude_columns
+        self.column_attrs = column_attrs
+        self.exclude_row_attrs = exclude_row_attrs
+        self.remote = remote
+        self.profile = profile
+
+
+class Executor:
+    """Single-node executor over a Holder. The cluster layer (parallel/)
+    wraps this with shard->node fan-out."""
+
+    def __init__(self, holder):
+        self.holder = holder
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, index_name, query, shards=None, options=None):
+        """Execute a PQL string or Query; returns a list of results, one per
+        top-level call (reference: executor.Execute executor.go:113)."""
+        import jax.numpy as jnp  # noqa: F401  (ensures device runtime ready)
+
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise ExecError(f"index not found: {index_name}")
+        if isinstance(query, str):
+            query = parse(query)
+        opt = options or ExecOptions()
+
+        results = []
+        for call in query.calls:
+            results.append(self.execute_call(idx, call, shards, opt))
+        return results
+
+    def execute_call(self, idx, call, shards, opt):
+        handler = {
+            "Sum": self._exec_sum,
+            "Min": self._exec_min,
+            "Max": self._exec_max,
+            "MinRow": self._exec_min_row,
+            "MaxRow": self._exec_max_row,
+            "Count": self._exec_count,
+            "TopN": self._exec_topn,
+            "Rows": self._exec_rows,
+            "GroupBy": self._exec_group_by,
+            "Options": self._exec_options,
+            "Set": self._exec_set,
+            "Clear": self._exec_clear,
+            "ClearRow": self._exec_clear_row,
+            "Store": self._exec_store,
+            "SetRowAttrs": self._exec_set_row_attrs,
+            "SetColumnAttrs": self._exec_set_column_attrs,
+        }.get(call.name)
+        if handler is not None:
+            return handler(idx, call, shards, opt)
+        # default: bitmap call
+        return self._exec_bitmap_call(idx, call, shards, opt)
+
+    # ------------------------------------------------------- shard selection
+
+    def _call_shards(self, idx, shards):
+        if shards is not None:
+            return list(shards)
+        return idx.available_shards()
+
+    # ------------------------------------------------------- bitmap calls
+
+    def validate_bitmap_call(self, idx, call):
+        """Structural checks independent of shard data (so empty indexes
+        still reject malformed queries, matching the reference's per-shard
+        errors)."""
+        name = call.name
+        if name in ("Intersect", "Difference", "Xor") and not call.children:
+            raise ExecError(f"empty {name} query is currently not supported")
+        if name == "Not":
+            if len(call.children) != 1:
+                raise ExecError("Not() takes exactly one row query")
+            if not idx.options.track_existence:
+                raise ExecError("Not() requires existence tracking on the index")
+        if name == "Shift" and len(call.children) != 1:
+            raise ExecError("Shift() takes exactly one row query")
+        if name in ("Row", "Range"):
+            field_name = call.field_arg() if not call.has_conditions() else \
+                next(iter(call.args))
+            if idx.field(field_name) is None:
+                raise FieldNotFound(f"field not found: {field_name}")
+        known = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
+                 "Not", "Shift", "All"}
+        if name not in known:
+            raise ExecError(f"unknown call: {name}")
+        for child in call.children:
+            self.validate_bitmap_call(idx, child)
+
+    def _exec_bitmap_call(self, idx, call, shards, opt):
+        import jax.numpy as jnp
+
+        self.validate_bitmap_call(idx, call)
+        row = Row()
+        for shard in self._call_shards(idx, shards):
+            plane = self.bitmap_call_shard(idx, call, shard)
+            if plane is None:
+                continue
+            host = np.asarray(plane)
+            if host.any():
+                row.segments[shard] = host
+        return row
+
+    def _zeros(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros(WORDS_PER_ROW, dtype=jnp.uint32)
+
+    def bitmap_call_shard(self, idx, call, shard):
+        """Evaluate a bitmap call tree for one shard -> device plane (or
+        None when provably empty). Reference: executeBitmapCallShard
+        executor.go:651."""
+        from ..ops import bitplane
+
+        name = call.name
+        if name == "Row":
+            return self._row_shard(idx, call, shard)
+        if name == "Range":  # deprecated alias for Row
+            return self._row_shard(idx, call, shard)
+        if name == "Intersect":
+            if not call.children:
+                raise ExecError("empty Intersect query is currently not supported")
+            planes = [self.bitmap_call_shard(idx, c, shard)
+                      for c in call.children]
+            if any(p is None for p in planes):
+                return None
+            out = planes[0]
+            for p in planes[1:]:
+                out = bitplane.intersect(out, p)
+            return out
+        if name == "Union":
+            planes = [self.bitmap_call_shard(idx, c, shard)
+                      for c in call.children]
+            planes = [p for p in planes if p is not None]
+            if not planes:
+                return None
+            out = planes[0]
+            for p in planes[1:]:
+                out = bitplane.union(out, p)
+            return out
+        if name == "Difference":
+            if not call.children:
+                raise ExecError("empty Difference query is currently not supported")
+            first = self.bitmap_call_shard(idx, call.children[0], shard)
+            if first is None:
+                return None
+            out = first
+            for c in call.children[1:]:
+                p = self.bitmap_call_shard(idx, c, shard)
+                if p is not None:
+                    out = bitplane.difference(out, p)
+            return out
+        if name == "Xor":
+            planes = [self.bitmap_call_shard(idx, c, shard)
+                      for c in call.children]
+            planes = [p if p is not None else self._zeros() for p in planes]
+            if not planes:
+                raise ExecError("empty Xor query is currently not supported")
+            out = planes[0]
+            for p in planes[1:]:
+                out = bitplane.xor(out, p)
+            return out
+        if name == "Not":
+            if not idx.options.track_existence:
+                raise ExecError("Not() requires existence tracking on the index")
+            if len(call.children) != 1:
+                raise ExecError("Not() takes exactly one row query")
+            exists = self._existence_plane(idx, shard)
+            if exists is None:
+                return None
+            child = self.bitmap_call_shard(idx, call.children[0], shard)
+            if child is None:
+                return exists
+            return bitplane.difference(exists, child)
+        if name == "Shift":
+            if len(call.children) != 1:
+                raise ExecError("Shift() takes exactly one row query")
+            n = int(call.args.get("n", 1))
+            child = self.bitmap_call_shard(idx, call.children[0], shard)
+            if child is None:
+                return None
+            # NOTE per-shard shift only; cross-segment carry is handled by
+            # the reference the same way (Row.Shift shifts within segments).
+            return bitplane.shift(child, n)
+        if name == "All":
+            exists = self._existence_plane(idx, shard)
+            return exists
+        raise ExecError(f"unknown call: {name}")
+
+    def _existence_plane(self, idx, shard):
+        field = idx.existence_field()
+        if field is None:
+            return None
+        return self._fragment_row_plane(field, VIEW_STANDARD, shard, 0)
+
+    def _fragment_row_plane(self, field, view_name, shard, row_id):
+        view = field.view(view_name)
+        if view is None:
+            return None
+        frag = view.fragment(shard)
+        if frag is None:
+            return None
+        return frag.row_device(row_id)
+
+    def _row_shard(self, idx, call, shard):
+        """Row(field=rowID), Row(field=rowID, from=..., to=...), or BSI
+        Row(field <op> value). Reference: executeRowShard executor.go:1441."""
+        if call.has_conditions():
+            return self._row_bsi_shard(idx, call, shard)
+
+        field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        row_id = call.args[field_name]
+        if isinstance(row_id, bool):
+            row_id = 1 if row_id else 0
+        if not isinstance(row_id, int):
+            raise ExecError(
+                f"Row(): row ID must be an integer or key: {row_id!r}")
+
+        has_time = "from" in call.args or "to" in call.args
+        if not has_time:
+            return self._fragment_row_plane(field, VIEW_STANDARD, shard, row_id)
+
+        if field.type != FIELD_TYPE_TIME:
+            raise ExecError(f"field {field_name} is not a time field")
+        from_t = timeq.parse_time(call.args["from"]) if "from" in call.args \
+            else timeq.parse_time("1970-01-01T00:00")
+        to_t = timeq.parse_time(call.args["to"]) if "to" in call.args \
+            else timeq.parse_time("2100-01-01T00:00")
+        from ..ops import bitplane
+
+        out = None
+        for view_name in timeq.views_by_time_range(
+                VIEW_STANDARD, from_t, to_t, field.time_quantum()):
+            plane = self._fragment_row_plane(field, view_name, shard, row_id)
+            if plane is None:
+                continue
+            out = plane if out is None else bitplane.union(out, plane)
+        return out
+
+    # -- BSI row conditions --------------------------------------------------
+
+    def _bsi_meta(self, idx, field_name):
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        if field.type != FIELD_TYPE_INT:
+            raise ExecError(f"field {field_name} is not an int field")
+        return field
+
+    def _bsi_planes(self, field, shard):
+        """(planes [D,W], sign, exists) device arrays, or None if fragment
+        absent."""
+        import jax.numpy as jnp
+
+        view = field.view(field.bsi_view_name())
+        if view is None:
+            return None
+        frag = view.fragment(shard)
+        if frag is None:
+            return None
+        depth = field.options.bit_depth
+        exists = frag.row_device(BSI_EXISTS_BIT)
+        sign = frag.row_device(BSI_SIGN_BIT)
+        planes = jnp.stack([
+            frag.row_device(BSI_OFFSET_BIT + i) for i in range(depth)])
+        return planes, sign, exists
+
+    def _not_null_plane(self, field, shard):
+        view = field.view(field.bsi_view_name())
+        if view is None:
+            return None
+        frag = view.fragment(shard)
+        if frag is None:
+            return None
+        return frag.row_device(BSI_EXISTS_BIT)
+
+    def _row_bsi_shard(self, idx, call, shard):
+        from ..ops import bitplane, bsi as bsi_ops
+        import jax.numpy as jnp
+
+        if len(call.args) != 1:
+            raise ExecError("Row(): condition required" if not call.args
+                            else "Row(): too many arguments")
+        field_name, cond = next(iter(call.args.items()))
+        if not isinstance(cond, Condition):
+            raise ExecError(f"Row(): expected condition argument")
+        field = self._bsi_meta(idx, field_name)
+        opts = field.options
+        depth = opts.bit_depth
+        depth_min = opts.base - (1 << depth) + 1
+        depth_max = opts.base + (1 << depth) - 1
+
+        if cond.op == NEQ and cond.value is None:
+            # != null
+            return self._not_null_plane(field, shard)
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_values()
+            if len(predicates) != 2:
+                raise ExecError(
+                    "Row(): BETWEEN condition requires exactly two integer values")
+            lo, hi = predicates
+            if hi < depth_min or lo > depth_max:
+                return None
+            lo_c = max(lo, depth_min) - opts.base
+            hi_c = min(hi, depth_max) - opts.base
+            data = self._bsi_planes(field, shard)
+            if data is None:
+                return None
+            planes, sign, exists = data
+            if lo <= opts.min and hi >= opts.max:
+                return exists
+            return self._between(planes, sign, exists, lo_c, hi_c, depth)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise ExecError("Row(): conditions only support integer values")
+        value = cond.value
+
+        # out-of-depth-range clamping (reference: bsiGroup.baseValue)
+        if cond.op in (GT, GTE):
+            if value > depth_max:
+                return None
+            base_value = value - opts.base if value > depth_min else \
+                depth_min - opts.base
+        elif cond.op in (LT, LTE):
+            if value < depth_min:
+                return None
+            base_value = (min(value, depth_max)) - opts.base
+        else:  # EQ / NEQ
+            out_of_range = value < depth_min or value > depth_max
+            if out_of_range and cond.op == EQ:
+                return None
+            if out_of_range:  # NEQ out of range -> all not-null
+                return self._not_null_plane(field, shard)
+            base_value = value - opts.base
+
+        data = self._bsi_planes(field, shard)
+        if data is None:
+            return None
+        planes, sign, exists = data
+
+        # full-range fast path -> notNull (reference: executor.go:1650)
+        if ((cond.op == LT and value > opts.max)
+                or (cond.op == LTE and value >= opts.max)
+                or (cond.op == GT and value < opts.min)
+                or (cond.op == GTE and value <= opts.min)):
+            return exists
+
+        pbits = jnp.asarray(bsi_ops.predicate_bits(abs(base_value), depth))
+        neg = base_value < 0
+        if cond.op == EQ:
+            return bsi_ops.range_eq(planes, sign, exists, pbits, neg)
+        if cond.op == NEQ:
+            eq = bsi_ops.range_eq(planes, sign, exists, pbits, neg)
+            return bitplane.difference(exists, eq)
+        if cond.op in (LT, LTE):
+            return bsi_ops.range_lt(planes, sign, exists, pbits, neg,
+                                    cond.op == LTE)
+        return bsi_ops.range_gt(planes, sign, exists, pbits, neg,
+                                cond.op == GTE)
+
+    def _between(self, planes, sign, exists, lo, hi, depth):
+        """Signed BETWEEN via unsigned magnitude compares on the sign slices
+        (reference: fragment.rangeBetween fragment.go:1437)."""
+        from ..ops import bitplane, bsi as bsi_ops
+        import jax.numpy as jnp
+
+        pos = bitplane.difference(exists, sign)
+        neg = bitplane.intersect(exists, sign)
+
+        def ubits(v):
+            return jnp.asarray(bsi_ops.predicate_bits(abs(v), depth))
+
+        if lo >= 0:
+            # all within positives
+            return bsi_ops.range_between_unsigned(
+                planes, pos, ubits(lo), ubits(hi))
+        if hi < 0:
+            # all within negatives: magnitudes between |hi| and |lo|
+            return bsi_ops.range_between_unsigned(
+                planes, neg, ubits(hi), ubits(lo))
+        # straddles zero: negatives with mag <= |lo|, positives with mag <= hi
+        lower = bsi_ops.range_between_unsigned(
+            planes, neg, ubits(0), ubits(lo))
+        upper = bsi_ops.range_between_unsigned(
+            planes, pos, ubits(0), ubits(hi))
+        return bitplane.union(lower, upper)
+
+    # ------------------------------------------------------------ aggregates
+
+    def _exec_count(self, idx, call, shards, opt):
+        """(reference: executeCount executor.go:1790)"""
+        from ..ops import bitplane
+        import jax.numpy as jnp
+
+        if len(call.children) != 1:
+            raise ExecError("Count() takes exactly one row query")
+        counts = []
+        for shard in self._call_shards(idx, shards):
+            plane = self.bitmap_call_shard(idx, call.children[0], shard)
+            if plane is not None:
+                counts.append(bitplane.popcount(plane))
+        if not counts:
+            return 0
+        return int(jnp.sum(jnp.stack(counts)))
+
+    def _sum_filter_planes(self, idx, call, shard):
+        """Returns (has_filter, plane). has_filter with plane None means the
+        filter is provably empty in this shard — the shard contributes
+        nothing (distinct from 'no filter given')."""
+        if call.children:
+            return True, self.bitmap_call_shard(idx, call.children[0], shard)
+        return False, None
+
+    def _agg_field(self, idx, call):
+        field_name = call.args.get("field") or call.args.get("_field")
+        if field_name is None:
+            field_name = call.field_arg()
+        return self._bsi_meta(idx, field_name)
+
+    def _exec_sum(self, idx, call, shards, opt):
+        """(reference: executeSum executor.go:331 + fragment.sum)"""
+        from ..ops import bsi as bsi_ops
+        import jax.numpy as jnp
+
+        field = self._agg_field(idx, call)
+        opts = field.options
+        depth = opts.bit_depth
+        per_shard = []
+        for shard in self._call_shards(idx, shards):
+            data = self._bsi_planes(field, shard)
+            if data is None:
+                continue
+            planes, sign, exists = data
+            has_filter, filt = self._sum_filter_planes(idx, call, shard)
+            if has_filter and filt is None:
+                continue  # empty filter -> shard contributes nothing
+            if filt is None:
+                filt = jnp.full(WORDS_PER_ROW, 0xFFFFFFFF, dtype=jnp.uint32)
+            per_shard.append(bsi_ops.bsi_plane_counts(planes, sign, exists, filt))
+        total, count = 0, 0
+        for pos, negc, cnt in per_shard:
+            pos = np.asarray(pos)
+            negc = np.asarray(negc)
+            total += sum(int(pos[i]) << i for i in range(depth))
+            total -= sum(int(negc[i]) << i for i in range(depth))
+            count += int(cnt)
+        # base contributes once per existing column (reference: Sum adds
+        # base*count since stored values are base-adjusted)
+        total += opts.base * count
+        return ValCount(total, count)
+
+    def _minmax_shard(self, field, idx, call, shard, is_max):
+        from ..ops import bitplane, bsi as bsi_ops
+
+        data = self._bsi_planes(field, shard)
+        if data is None:
+            return ValCount()
+        planes, sign, exists = data
+        consider = exists
+        has_filter, filt = self._sum_filter_planes(idx, call, shard)
+        if has_filter and filt is None:
+            return ValCount()
+        if filt is not None:
+            consider = bitplane.intersect(consider, filt)
+        if not bool(bitplane.any_set(consider)):
+            return ValCount()
+        pos = bitplane.difference(consider, sign)
+        neg = bitplane.intersect(consider, sign)
+        has_pos = bool(bitplane.any_set(pos))
+        has_neg = bool(bitplane.any_set(neg))
+        if is_max:
+            # highest positive, else closest-to-zero negative (reference:
+            # fragment.max fragment.go:1190)
+            if has_pos:
+                bits, final = bsi_ops.max_unsigned(planes, pos)
+                sign_mult = 1
+            else:
+                bits, final = bsi_ops.min_unsigned(planes, neg)
+                sign_mult = -1
+        else:
+            # lowest negative (largest magnitude), else lowest positive
+            if has_neg:
+                bits, final = bsi_ops.max_unsigned(planes, neg)
+                sign_mult = -1
+            else:
+                bits, final = bsi_ops.min_unsigned(planes, pos)
+                sign_mult = 1
+        bits = np.asarray(bits)
+        mag = sum(int(b) << i for i, b in enumerate(bits))
+        count = int(bitplane.popcount(final))
+        return ValCount(sign_mult * mag + field.options.base, count)
+
+    def _exec_min(self, idx, call, shards, opt):
+        field = self._agg_field(idx, call)
+        out = ValCount()
+        for shard in self._call_shards(idx, shards):
+            out = out.smaller(self._minmax_shard(field, idx, call, shard, False))
+        return out
+
+    def _exec_max(self, idx, call, shards, opt):
+        field = self._agg_field(idx, call)
+        out = ValCount()
+        for shard in self._call_shards(idx, shards):
+            out = out.larger(self._minmax_shard(field, idx, call, shard, True))
+        return out
+
+    def _set_field(self, idx, call):
+        field_name = call.args.get("field") or call.args.get("_field")
+        if field_name is None:
+            field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        return field
+
+    def _exec_min_row(self, idx, call, shards, opt):
+        """(reference: executeMinRow executor.go:380 + fragment.minRow)"""
+        return self._minmax_row(idx, call, shards, is_max=False)
+
+    def _exec_max_row(self, idx, call, shards, opt):
+        return self._minmax_row(idx, call, shards, is_max=True)
+
+    def _minmax_row(self, idx, call, shards, is_max):
+        from ..ops import bitplane
+
+        field = self._set_field(idx, call)
+        best = None  # (row_id, count)
+        for shard in self._call_shards(idx, shards):
+            view = field.view(VIEW_STANDARD)
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            filt = None
+            if call.children:
+                filt = self.bitmap_call_shard(idx, call.children[0], shard)
+                if filt is None:
+                    continue
+            for row_id in (reversed(frag.row_ids()) if is_max
+                           else frag.row_ids()):
+                plane = frag.row_device(row_id)
+                if filt is not None:
+                    plane = bitplane.intersect(plane, filt)
+                cnt = int(bitplane.popcount(plane))
+                if cnt > 0:
+                    if best is None or (is_max and row_id > best[0]) or \
+                            (not is_max and row_id < best[0]):
+                        best = (row_id, cnt)
+                    elif row_id == best[0]:
+                        best = (row_id, best[1] + cnt)
+                    break
+        if best is None:
+            return Pair(0, 0)
+        return Pair(best[0], best[1])
+
+    # ---------------------------------------------------------------- TopN
+
+    def _exec_topn(self, idx, call, shards, opt):
+        """Exact TopN via device popcounts (the reference approximates with
+        per-fragment rank caches + heap merge, executor.go:930; dense planes
+        make the exact computation cheap)."""
+        field = self._set_field(idx, call)
+        n = call.args.get("n")
+        ids = call.args.get("ids")
+        counts = self._row_counts(idx, field, call, shards,
+                                  restrict_ids=ids)
+        pairs = [Pair(row_id, cnt) for row_id, cnt in counts.items() if cnt > 0]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        if n is not None and ids is None:
+            pairs = pairs[:int(n)]
+        return pairs
+
+    def _row_counts(self, idx, field, call, shards, restrict_ids=None,
+                    view_name=VIEW_STANDARD):
+        """row -> total count across shards, optionally intersected with the
+        call's first child as filter."""
+        from ..ops import bitplane
+        import jax.numpy as jnp
+
+        totals = {}
+        pending = []  # (row_ids_chunk, device_counts)
+        for shard in self._call_shards(idx, shards):
+            view = field.view(view_name)
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            filt = None
+            if call is not None and call.children:
+                filt = self.bitmap_call_shard(idx, call.children[0], shard)
+                if filt is None:
+                    continue  # empty filter -> zero counts in this shard
+            row_ids = frag.row_ids()
+            if restrict_ids is not None:
+                wanted = {int(r) for r in restrict_ids}
+                row_ids = [r for r in row_ids if r in wanted]
+            for i in range(0, len(row_ids), _TOPN_STACK_CHUNK):
+                chunk = row_ids[i:i + _TOPN_STACK_CHUNK]
+                stack = jnp.stack([frag.row_device(r) for r in chunk])
+                if filt is not None:
+                    stack = stack & filt[None, :]
+                pending.append((chunk, bitplane.popcount_rows(stack)))
+        for chunk, dev_counts in pending:
+            host = np.asarray(dev_counts)
+            for r, c in zip(chunk, host):
+                totals[r] = totals.get(r, 0) + int(c)
+        if restrict_ids is not None:
+            for r in restrict_ids:
+                totals.setdefault(int(r), 0)
+        return totals
+
+    # ---------------------------------------------------------------- Rows
+
+    def _exec_rows(self, idx, call, shards, opt):
+        """(reference: executeRows executor.go:1280)"""
+        field = self._set_field(idx, call)
+        limit = call.args.get("limit")
+        previous = call.args.get("previous")
+        column = call.args.get("column")
+
+        rows = set()
+        for shard in self._call_shards(idx, shards):
+            view = field.view(VIEW_STANDARD)
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            if column is not None:
+                if int(column) // SHARD_WIDTH != shard:
+                    continue
+                for r in frag.row_ids():
+                    if frag.contains(r, int(column)):
+                        rows.add(r)
+            else:
+                rows.update(frag.row_ids())
+        out = sorted(rows)
+        if previous is not None:
+            out = [r for r in out if r > int(previous)]
+        if limit is not None:
+            out = out[:int(limit)]
+        return RowIdentifiers(rows=out)
+
+    # -------------------------------------------------------------- GroupBy
+
+    def _exec_group_by(self, idx, call, shards, opt):
+        """(reference: executeGroupBy executor.go:1098)"""
+        from ..ops import bitplane
+        import jax.numpy as jnp
+
+        if not call.children:
+            raise ExecError("GroupBy requires at least one Rows() child")
+        for child in call.children:
+            if child.name != "Rows":
+                raise ExecError("GroupBy children must be Rows() calls")
+        limit = call.args.get("limit")
+        filter_call = call.args.get("filter")
+        if filter_call is not None and not isinstance(filter_call, Call):
+            raise ExecError("GroupBy filter must be a row query")
+
+        fields = [self._set_field(idx, child) for child in call.children]
+        shard_list = self._call_shards(idx, shards)
+
+        totals = {}
+        for shard in shard_list:
+            frag_rows = []
+            ok = True
+            for field, child in zip(fields, call.children):
+                view = field.view(VIEW_STANDARD)
+                frag = view.fragment(shard) if view else None
+                if frag is None:
+                    ok = False
+                    break
+                row_ids = frag.row_ids()
+                prev = child.args.get("previous")
+                if prev is not None:
+                    row_ids = [r for r in row_ids if r > int(prev)]
+                lim = child.args.get("limit")
+                if lim is not None:
+                    row_ids = row_ids[:int(lim)]
+                frag_rows.append((frag, row_ids))
+            if not ok:
+                continue
+            filt = None
+            if filter_call is not None:
+                filt = self.bitmap_call_shard(idx, filter_call, shard)
+                if filt is None:
+                    continue
+
+            # depth-first cross product with early pruning on empty planes
+            pending = []
+
+            def recurse(level, plane, prefix):
+                frag, row_ids = frag_rows[level]
+                for row_id in row_ids:
+                    p = frag.row_device(row_id)
+                    combined = p if plane is None else bitplane.intersect(plane, p)
+                    if level + 1 == len(frag_rows):
+                        pending.append((prefix + (row_id,),
+                                        bitplane.popcount(combined)))
+                    else:
+                        recurse(level + 1, combined, prefix + (row_id,))
+
+            recurse(0, filt, ())
+            if pending:
+                groups, dev_counts = zip(*pending)
+                host = np.asarray(jnp.stack(list(dev_counts)))  # one sync
+                for group, c in zip(groups, host):
+                    if int(c) > 0:
+                        totals[group] = totals.get(group, 0) + int(c)
+
+        out = [
+            GroupCount(
+                [FieldRow(f.name, rid) for f, rid in zip(fields, group)],
+                cnt)
+            for group, cnt in sorted(totals.items())
+        ]
+        if limit is not None:
+            out = out[:int(limit)]
+        return out
+
+    # -------------------------------------------------------------- Options
+
+    def _exec_options(self, idx, call, shards, opt):
+        """(reference: executeOptionsCall executor.go:244)"""
+        if len(call.children) != 1:
+            raise ExecError("Options() takes exactly one query")
+        new_opt = ExecOptions(
+            shards=opt.shards, exclude_columns=opt.exclude_columns,
+            column_attrs=opt.column_attrs,
+            exclude_row_attrs=opt.exclude_row_attrs)
+        for key, value in call.args.items():
+            if key == "shards":
+                if not isinstance(value, list):
+                    raise ExecError("Options(): shards must be a list")
+                shards = [int(s) for s in value]
+            elif key == "excludeColumns":
+                new_opt.exclude_columns = bool(value)
+            elif key == "columnAttrs":
+                new_opt.column_attrs = bool(value)
+            elif key == "excludeRowAttrs":
+                new_opt.exclude_row_attrs = bool(value)
+            else:
+                raise ExecError(f"Options(): unknown arg {key!r}")
+        return self.execute_call(idx, call.children[0], shards, new_opt)
+
+    # ---------------------------------------------------------------- writes
+
+    def _exec_set(self, idx, call, shards, opt):
+        """(reference: executeSet executor.go:2067)"""
+        col = self._require_col(call)
+        field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        value = call.args[field_name]
+
+        if field.type == FIELD_TYPE_INT:
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise ExecError("Set(): int field requires an integer value")
+            changed = field.set_value(col, value)
+        else:
+            timestamp = None
+            if "_timestamp" in call.args:
+                timestamp = timeq.parse_time(call.args["_timestamp"])
+            if isinstance(value, bool):
+                row_id = 1 if value else 0
+            elif isinstance(value, int):
+                row_id = value
+            else:
+                raise ExecError(
+                    f"Set(): row must be an integer or key: {value!r}")
+            changed = field.set_bit(row_id, col, timestamp=timestamp)
+        idx.add_existence([col])
+        return bool(changed)
+
+    def _exec_clear(self, idx, call, shards, opt):
+        col = self._require_col(call)
+        field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        value = call.args[field_name]
+        if field.type == FIELD_TYPE_INT:
+            return bool(field.clear_value(col))
+        if isinstance(value, bool):
+            row_id = 1 if value else 0
+        else:
+            row_id = int(value)
+        return bool(field.clear_bit(row_id, col))
+
+    def _exec_clear_row(self, idx, call, shards, opt):
+        """(reference: executeClearRow executor.go:1825)"""
+        field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            raise FieldNotFound(f"field not found: {field_name}")
+        row_id = int(call.args[field_name])
+        zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+        changed = False
+        shard_list = self._call_shards(idx, shards)
+        # Clear across every non-BSI view so time views stay consistent with
+        # the standard view (reference: executeClearRowShard walks f.views()).
+        for view_name, view in list(field.views.items()):
+            if view_name.startswith("bsig_"):
+                continue
+            for shard in shard_list:
+                frag = view.fragment(shard)
+                if frag is not None:
+                    changed |= bool(frag.set_row_plane(row_id, zeros))
+        return changed
+
+    def _exec_store(self, idx, call, shards, opt):
+        """(reference: executeSetRow executor.go:1900) Store(child, f=row)"""
+        if len(call.children) != 1:
+            raise ExecError("Store() takes exactly one row query")
+        field_name = call.field_arg()
+        field = idx.field(field_name)
+        if field is None:
+            # reference creates the field on demand for Store
+            from ..core.field import FieldOptions
+
+            field = idx.create_field(field_name, FieldOptions())
+        row_id = int(call.args[field_name])
+        view = field.create_view_if_not_exists(VIEW_STANDARD)
+        changed = False
+        for shard in self._call_shards(idx, shards):
+            plane = self.bitmap_call_shard(idx, call.children[0], shard)
+            host = (np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+                    if plane is None else np.asarray(plane))
+            frag = view.create_fragment_if_not_exists(shard)
+            changed |= bool(frag.set_row_plane(row_id, host))
+        return changed
+
+    def _exec_set_row_attrs(self, idx, call, shards, opt):
+        field = idx.field(call.args["_field"])
+        if field is None:
+            raise FieldNotFound(f"field not found: {call.args['_field']}")
+        if field.row_attr_store is None:
+            raise ExecError("row attributes not configured")
+        row_id = int(call.args["_row"])
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        field.row_attr_store.set_attrs(row_id, attrs)
+        return None
+
+    def _exec_set_column_attrs(self, idx, call, shards, opt):
+        if idx.column_attr_store is None:
+            raise ExecError("column attributes not configured")
+        col = self._require_col(call)
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        idx.column_attr_store.set_attrs(col, attrs)
+        return None
+
+    def _require_col(self, call):
+        col = call.args.get("_col")
+        if col is None:
+            raise ExecError(f"{call.name}() requires a column argument")
+        if not isinstance(col, int) or isinstance(col, bool):
+            raise ExecError(f"column must be an integer or key: {col!r}")
+        return col

@@ -1,0 +1,141 @@
+"""Query result types (reference: executor.go / pilosa.go result structs).
+
+JSON shapes mirror the reference's HTTP QueryResponse encodings
+(http/handler.go QueryResult marshaling).
+"""
+
+
+class ValCount:
+    """Sum/Min/Max result (reference: ValCount pilosa.go)."""
+
+    __slots__ = ("val", "count")
+
+    def __init__(self, val=0, count=0):
+        self.val = int(val)
+        self.count = int(count)
+
+    def add(self, other):
+        return ValCount(self.val + other.val, self.count + other.count)
+
+    def smaller(self, other):
+        if other.count == 0:
+            return self
+        if self.count == 0 or other.val < self.val:
+            return other
+        if other.val == self.val:
+            return ValCount(self.val, self.count + other.count)
+        return self
+
+    def larger(self, other):
+        if other.count == 0:
+            return self
+        if self.count == 0 or other.val > self.val:
+            return other
+        if other.val == self.val:
+            return ValCount(self.val, self.count + other.count)
+        return self
+
+    def to_json(self):
+        return {"value": self.val, "count": self.count}
+
+    def __eq__(self, other):
+        return (isinstance(other, ValCount) and self.val == other.val
+                and self.count == other.count)
+
+    def __repr__(self):
+        return f"ValCount(val={self.val}, count={self.count})"
+
+
+class Pair:
+    """TopN entry (reference: Pair pilosa.go)."""
+
+    __slots__ = ("id", "key", "count")
+
+    def __init__(self, id=0, count=0, key=None):
+        self.id = int(id)
+        self.count = int(count)
+        self.key = key
+
+    def to_json(self):
+        out = {"id": self.id, "count": self.count}
+        if self.key is not None:
+            out["key"] = self.key
+        return out
+
+    def __eq__(self, other):
+        return (isinstance(other, Pair) and self.id == other.id
+                and self.count == other.count and self.key == other.key)
+
+    def __repr__(self):
+        return f"Pair(id={self.id}, count={self.count})"
+
+
+class RowIdentifiers:
+    """Rows() result (reference: RowIdentifiers executor.go)."""
+
+    __slots__ = ("rows", "keys")
+
+    def __init__(self, rows=None, keys=None):
+        self.rows = list(rows or [])
+        self.keys = keys
+
+    def to_json(self):
+        out = {"rows": self.rows}
+        if self.keys is not None:
+            out["keys"] = self.keys
+        return out
+
+    def __eq__(self, other):
+        return (isinstance(other, RowIdentifiers) and self.rows == other.rows
+                and self.keys == other.keys)
+
+    def __repr__(self):
+        return f"RowIdentifiers({self.rows})"
+
+
+class FieldRow:
+    """One (field, row) of a GroupBy group (reference: FieldRow executor.go)."""
+
+    __slots__ = ("field", "row_id", "row_key")
+
+    def __init__(self, field, row_id, row_key=None):
+        self.field = field
+        self.row_id = int(row_id)
+        self.row_key = row_key
+
+    def to_json(self):
+        out = {"field": self.field, "rowID": self.row_id}
+        if self.row_key is not None:
+            out["rowKey"] = self.row_key
+        return out
+
+    def __eq__(self, other):
+        return (isinstance(other, FieldRow) and self.field == other.field
+                and self.row_id == other.row_id and self.row_key == other.row_key)
+
+    def __hash__(self):
+        return hash((self.field, self.row_id, self.row_key))
+
+    def __repr__(self):
+        return f"FieldRow({self.field}={self.row_id})"
+
+
+class GroupCount:
+    """GroupBy entry (reference: GroupCount executor.go)."""
+
+    __slots__ = ("group", "count")
+
+    def __init__(self, group, count):
+        self.group = list(group)
+        self.count = int(count)
+
+    def to_json(self):
+        return {"group": [fr.to_json() for fr in self.group],
+                "count": self.count}
+
+    def __eq__(self, other):
+        return (isinstance(other, GroupCount) and self.group == other.group
+                and self.count == other.count)
+
+    def __repr__(self):
+        return f"GroupCount({self.group}, {self.count})"
