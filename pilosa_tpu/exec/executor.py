@@ -448,6 +448,7 @@ class Executor:
 
         if len(call.children) != 1:
             raise ExecError("Count() takes exactly one row query")
+        self.validate_bitmap_call(idx, call.children[0])
         counts = []
         for shard in self._call_shards(idx, shards):
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
@@ -462,6 +463,7 @@ class Executor:
         filter is provably empty in this shard — the shard contributes
         nothing (distinct from 'no filter given')."""
         if call.children:
+            self.validate_bitmap_call(idx, call.children[0])
             return True, self.bitmap_call_shard(idx, call.children[0], shard)
         return False, None
 
@@ -578,6 +580,8 @@ class Executor:
         from ..ops import bitplane
 
         field = self._set_field(idx, call)
+        if call.children:
+            self.validate_bitmap_call(idx, call.children[0])
         best = None  # (row_id, count)
         for shard in self._call_shards(idx, shards):
             view = field.view(VIEW_STANDARD)
@@ -613,6 +617,8 @@ class Executor:
         per-fragment rank caches + heap merge, executor.go:930; dense planes
         make the exact computation cheap)."""
         field = self._set_field(idx, call)
+        if call.children:
+            self.validate_bitmap_call(idx, call.children[0])
         n = call.args.get("n")
         ids = call.args.get("ids")
         counts = self._row_counts(idx, field, call, shards,
@@ -705,30 +711,46 @@ class Executor:
                 raise ExecError("GroupBy children must be Rows() calls")
         limit = call.args.get("limit")
         filter_call = call.args.get("filter")
-        if filter_call is not None and not isinstance(filter_call, Call):
-            raise ExecError("GroupBy filter must be a row query")
+        if filter_call is not None:
+            if not isinstance(filter_call, Call):
+                raise ExecError("GroupBy filter must be a row query")
+            self.validate_bitmap_call(idx, filter_call)
 
         fields = [self._set_field(idx, child) for child in call.children]
         shard_list = self._call_shards(idx, shards)
+
+        # Child Rows() limit/previous apply to the GLOBAL merged row set
+        # (matching Rows() itself), not per shard.
+        child_rows = []
+        for field, child in zip(fields, call.children):
+            rows = set()
+            view = field.view(VIEW_STANDARD)
+            if view is not None:
+                for shard in shard_list:
+                    frag = view.fragment(shard)
+                    if frag is not None:
+                        rows.update(frag.row_ids())
+            rows = sorted(rows)
+            prev = child.args.get("previous")
+            if prev is not None:
+                rows = [r for r in rows if r > int(prev)]
+            lim = child.args.get("limit")
+            if lim is not None:
+                rows = rows[:int(lim)]
+            child_rows.append(rows)
 
         totals = {}
         for shard in shard_list:
             frag_rows = []
             ok = True
-            for field, child in zip(fields, call.children):
+            for field, rows in zip(fields, child_rows):
                 view = field.view(VIEW_STANDARD)
                 frag = view.fragment(shard) if view else None
                 if frag is None:
                     ok = False
                     break
-                row_ids = frag.row_ids()
-                prev = child.args.get("previous")
-                if prev is not None:
-                    row_ids = [r for r in row_ids if r > int(prev)]
-                lim = child.args.get("limit")
-                if lim is not None:
-                    row_ids = row_ids[:int(lim)]
-                frag_rows.append((frag, row_ids))
+                present = set(frag.row_ids())
+                frag_rows.append((frag, [r for r in rows if r in present]))
             if not ok:
                 continue
             filt = None
@@ -778,7 +800,8 @@ class Executor:
         new_opt = ExecOptions(
             shards=opt.shards, exclude_columns=opt.exclude_columns,
             column_attrs=opt.column_attrs,
-            exclude_row_attrs=opt.exclude_row_attrs)
+            exclude_row_attrs=opt.exclude_row_attrs,
+            remote=opt.remote, profile=opt.profile)
         for key, value in call.args.items():
             if key == "shards":
                 if not isinstance(value, list):

@@ -1,0 +1,5 @@
+"""Distribution layer: device mesh + shard placement + collectives
+(reference: cluster.go / executor.mapReduce — scale-out recast as SPMD over
+a "shards" mesh axis with ICI collectives)."""
+
+from .sharded import QueryKernels, ShardedQueryEngine

@@ -130,7 +130,6 @@ def test_count_multiple_calls(env):
     h, e = env
     idx = h.create_index("i")
     idx.create_field("f")
-    idx.create_field("f11") if False else None
     e.execute("i", "Set(1, f=10) Set(2, f=10)")
     assert e.execute("i", "Count(Row(f=10)) Count(Row(f=11))") == [2, 0]
 
@@ -358,3 +357,26 @@ def test_bsi_condition_on_missing_field_raises(env):
     h.create_index("i")
     with pytest.raises(Exception):
         e.execute("i", "Row(typo > 5)")
+
+
+def test_count_on_missing_field_empty_index(env):
+    # regression: aggregates validate subqueries even with zero shards
+    h, e = env
+    h.create_index("i")
+    with pytest.raises(Exception):
+        e.execute("i", "Count(Row(nonexistent=1))")
+    with pytest.raises(Exception):
+        e.execute("i", "TopN(nonexistent)")
+
+
+def test_group_by_limit_applies_globally(env):
+    # regression: child Rows() limit is global, not per shard
+    h, e = env
+    idx = h.create_index("i")
+    a = idx.create_field("a")
+    a.import_bits([1, 2, 2], [0, 1, SHARD_WIDTH + 1])
+    got = e.execute("i", "GroupBy(Rows(a, limit=1))")[0]
+    assert got == [GroupCount([FieldRow("a", 1)], 1)]
+    got = e.execute("i", "GroupBy(Rows(a))")[0]
+    assert got == [GroupCount([FieldRow("a", 1)], 1),
+                   GroupCount([FieldRow("a", 2)], 2)]
