@@ -53,10 +53,10 @@ def main():
     b = gen(kb)
     a.block_until_ready()
 
-    @jax.jit
-    def intersect_count(a, b):
-        # int32 is safe: 1B columns max < 2^31.
-        return jnp.sum(jax.lax.population_count(a & b).astype(jnp.int32))
+    from pilosa_tpu.parallel import QueryKernels
+
+    # The shipped serving kernel (module-cached jit; int32 safe: <2^31 cols).
+    intersect_count = QueryKernels.count_intersect
 
     # Warm-up/compile + correctness vs CPU ground truth on a slice.
     got = int(intersect_count(a, b))

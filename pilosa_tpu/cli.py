@@ -1,0 +1,246 @@
+"""Command-line interface (reference: cmd/ + ctl/ — cobra commands).
+
+Subcommands mirror the reference CLI (cmd/root.go:71-78): server, import,
+export, inspect, check, generate-config. Config comes from TOML file,
+PILOSA_TPU_* env vars, and flags (reference: server/config.go precedence).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+DEFAULT_CONFIG = {
+    "bind": "127.0.0.1:10101",
+    "data-dir": "~/.pilosa_tpu",
+    "max-op-n": 10000,
+    "cluster": {"coordinator": True, "nodes": []},
+    "anti-entropy": {"interval": "10m"},
+}
+
+
+def load_config(path=None):
+    """TOML file < env < flags (reference: server/config.go)."""
+    import tomllib
+
+    config = json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
+    if path:
+        with open(path, "rb") as f:
+            config.update(tomllib.load(f))
+    if os.environ.get("PILOSA_TPU_BIND"):
+        config["bind"] = os.environ["PILOSA_TPU_BIND"]
+    if os.environ.get("PILOSA_TPU_DATA_DIR"):
+        config["data-dir"] = os.environ["PILOSA_TPU_DATA_DIR"]
+    return config
+
+
+def cmd_server(args):
+    from .core import Holder
+    from .server import API, PilosaHTTPServer
+
+    config = load_config(args.config)
+    if args.bind:
+        config["bind"] = args.bind
+    if args.data_dir:
+        config["data-dir"] = args.data_dir
+    host, _, port = config["bind"].partition(":")
+    data_dir = os.path.expanduser(config["data-dir"])
+
+    holder = Holder(data_dir, max_op_n=config.get("max-op-n")).open()
+    api = API(holder)
+    server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
+    server.start()
+    print(f"pilosa_tpu server listening on {server.address} "
+          f"(data: {data_dir})", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        holder.close()
+    return 0
+
+
+def cmd_import(args):
+    """CSV bulk import via the HTTP API (reference: ctl/import.go)."""
+    import csv as csv_mod
+
+    from .server import Client
+
+    client = Client(args.host)
+    if args.create:
+        try:
+            client.create_index(args.index)
+        except Exception:
+            pass
+        try:
+            options = {}
+            if args.field_type == "int":
+                options = {"type": "int", "min": args.min, "max": args.max}
+            elif args.field_type == "time":
+                options = {"type": "time", "timeQuantum": args.time_quantum}
+            client.create_field(args.index, args.field, options)
+        except Exception:
+            pass
+
+    rows, cols, values = [], [], []
+    total = 0
+    source = open(args.file) if args.file != "-" else sys.stdin
+    try:
+        reader = csv_mod.reader(source)
+        for record in reader:
+            if not record:
+                continue
+            if args.field_type == "int":
+                cols.append(int(record[0]))
+                values.append(int(record[1]))
+            else:
+                rows.append(int(record[0]))
+                cols.append(int(record[1]))
+            if len(cols) >= args.batch_size:
+                total += _flush_import(client, args, rows, cols, values)
+                rows, cols, values = [], [], []
+        if cols:
+            total += _flush_import(client, args, rows, cols, values)
+    finally:
+        if source is not sys.stdin:
+            source.close()
+    print(f"imported: {total} changed bits")
+    return 0
+
+
+def _flush_import(client, args, rows, cols, values):
+    if args.field_type == "int":
+        out = client.import_values(args.index, args.field, cols, values)
+    else:
+        out = client.import_bits(args.index, args.field, rows, cols)
+    return out.get("changed", 0) if isinstance(out, dict) else 0
+
+
+def cmd_export(args):
+    """(reference: ctl/export.go)"""
+    from .server import Client
+
+    client = Client(args.host)
+    shards = range(args.shards) if args.shards else None
+    if shards is None:
+        status = client._request("GET", "/internal/shards/max")
+        max_shard = status.get("standard", {}).get(args.index, 0)
+        shards = range(max_shard + 1)
+    for shard in shards:
+        sys.stdout.write(client.export_csv(args.index, args.field, shard))
+    return 0
+
+
+def cmd_inspect(args):
+    """Dump fragment bit counts from a data file (reference:
+    ctl/inspect.go)."""
+    from .roaring import deserialize
+
+    with open(args.path, "rb") as f:
+        data = f.read()
+    bitmap, flags, ops = deserialize(data)
+    print(f"file: {args.path}")
+    print(f"flags: {flags}  ops-replayed: {ops}")
+    print(f"containers: {len(bitmap.keys())}  bits: {bitmap.count()}")
+    from .shardwidth import CONTAINERS_PER_SHARD
+
+    rows = {}
+    for key in bitmap.keys():
+        row = key // CONTAINERS_PER_SHARD
+        rows[row] = rows.get(row, 0) + bitmap.containers[key].n
+    for row in sorted(rows):
+        print(f"  row {row}: {rows[row]} bits")
+    return 0
+
+
+def cmd_check(args):
+    """Consistency-check fragment files (reference: ctl/check.go)."""
+    from .roaring import FormatError, deserialize
+
+    failed = 0
+    for path in args.paths:
+        try:
+            with open(path, "rb") as f:
+                bitmap, _, _ = deserialize(f.read())
+            for key in bitmap.keys():
+                c = bitmap.containers[key]
+                if c.n != c._count():
+                    raise FormatError(
+                        f"container {key}: cardinality mismatch")
+            print(f"{path}: ok")
+        except Exception as e:
+            failed += 1
+            print(f"{path}: FAILED — {e}")
+    return 1 if failed else 0
+
+
+def cmd_generate_config(args):
+    """(reference: ctl/generate_config.go) Print default TOML config."""
+    print('bind = "127.0.0.1:10101"')
+    print('data-dir = "~/.pilosa_tpu"')
+    print("max-op-n = 10000")
+    print()
+    print("[cluster]")
+    print("coordinator = true")
+    print("nodes = []")
+    print()
+    print('[anti-entropy]')
+    print('interval = "10m"')
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="pilosa_tpu", description="TPU-native distributed bitmap index")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("server", help="run the server daemon")
+    p.add_argument("--bind", default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--config", default=None)
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV data")
+    p.add_argument("--host", default="http://127.0.0.1:10101")
+    p.add_argument("--index", required=True)
+    p.add_argument("--field", required=True)
+    p.add_argument("--create", action="store_true",
+                   help="create index/field if missing")
+    p.add_argument("--field-type", default="set",
+                   choices=["set", "int", "time"])
+    p.add_argument("--min", type=int, default=0)
+    p.add_argument("--max", type=int, default=(1 << 31) - 1)
+    p.add_argument("--time-quantum", default="YMD")
+    p.add_argument("--batch-size", type=int, default=100_000)
+    p.add_argument("file", help="CSV path or - for stdin")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("export", help="export a field as CSV")
+    p.add_argument("--host", default="http://127.0.0.1:10101")
+    p.add_argument("--index", required=True)
+    p.add_argument("--field", required=True)
+    p.add_argument("--shards", type=int, default=None)
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("inspect", help="inspect a fragment data file")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("check", help="consistency-check fragment files")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("generate-config", help="print default config TOML")
+    p.set_defaults(fn=cmd_generate_config)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

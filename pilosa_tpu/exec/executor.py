@@ -719,25 +719,12 @@ class Executor:
         fields = [self._set_field(idx, child) for child in call.children]
         shard_list = self._call_shards(idx, shards)
 
-        # Child Rows() limit/previous apply to the GLOBAL merged row set
-        # (matching Rows() itself), not per shard.
-        child_rows = []
-        for field, child in zip(fields, call.children):
-            rows = set()
-            view = field.view(VIEW_STANDARD)
-            if view is not None:
-                for shard in shard_list:
-                    frag = view.fragment(shard)
-                    if frag is not None:
-                        rows.update(frag.row_ids())
-            rows = sorted(rows)
-            prev = child.args.get("previous")
-            if prev is not None:
-                rows = [r for r in rows if r > int(prev)]
-            lim = child.args.get("limit")
-            if lim is not None:
-                rows = rows[:int(lim)]
-            child_rows.append(rows)
+        # Child Rows() limit/previous/column apply to the GLOBAL merged row
+        # set (exactly Rows() semantics, reused).
+        child_rows = [
+            self._exec_rows(idx, child, shards, opt).rows
+            for child in call.children
+        ]
 
         totals = {}
         for shard in shard_list:

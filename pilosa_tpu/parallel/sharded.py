@@ -32,6 +32,9 @@ def _jax():
 def apply_op_chain(acc, planes, ops):
     """Fold an operator chain over aligned plane stacks — THE definition of
     expression semantics, shared by the single-device and mesh paths."""
+    if len(ops) != len(planes):
+        raise ValueError(
+            f"op chain length {len(ops)} != operand count {len(planes)}")
     for op, p in zip(ops, planes):
         if op == "&":
             acc = acc & p

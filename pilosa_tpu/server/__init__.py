@@ -1,0 +1,6 @@
+"""Server layer: API facade, HTTP transport, client (reference: api.go,
+http/, server/)."""
+
+from .api import API, ApiError, ConflictError, NotFoundError
+from .client import Client, ClientError
+from .http_server import PilosaHTTPServer

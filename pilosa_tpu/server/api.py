@@ -1,0 +1,298 @@
+"""API facade (reference: api.go).
+
+Sits between transports (HTTP, cluster-internal RPC) and the
+holder/executor. Validation of cluster-state-permitted methods
+(reference: api.validate api.go:119) hooks in once the cluster layer is
+attached; single-node mode permits everything.
+"""
+
+import io
+import csv
+
+import numpy as np
+
+from ..core import FieldOptions, Holder, IndexOptions
+from ..core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_SET,
+    FIELD_TYPE_TIME,
+)
+from ..exec import ExecOptions, Executor
+from ..pql import parse
+from ..shardwidth import SHARD_WIDTH
+from .. import __version__
+
+
+class ApiError(Exception):
+    status = 400
+
+
+class NotFoundError(ApiError):
+    status = 404
+
+
+class ConflictError(ApiError):
+    status = 409
+
+
+def field_options_from_json(opts):
+    """Build FieldOptions from the reference's JSON field-options wire shape
+    (reference: fieldOptions handler struct http/handler.go:870 +
+    FieldOptions.MarshalJSON field.go:1471)."""
+    opts = opts or {}
+    typ = opts.get("type", FIELD_TYPE_SET)
+    if typ == FIELD_TYPE_INT:
+        return FieldOptions.int_field(
+            min=int(opts.get("min", -(1 << 31))),
+            max=int(opts.get("max", (1 << 31) - 1)))
+    if typ == FIELD_TYPE_TIME:
+        return FieldOptions.time_field(
+            opts.get("timeQuantum", ""),
+            no_standard_view=bool(opts.get("noStandardView", False)))
+    if typ == FIELD_TYPE_MUTEX:
+        return FieldOptions.mutex_field(
+            cache_type=opts.get("cacheType", "ranked"),
+            cache_size=int(opts.get("cacheSize", 50000)))
+    if typ == FIELD_TYPE_BOOL:
+        return FieldOptions.bool_field()
+    if typ != FIELD_TYPE_SET:
+        raise ApiError(f"invalid field type: {typ}")
+    return FieldOptions(
+        cache_type=opts.get("cacheType", "ranked"),
+        cache_size=int(opts.get("cacheSize", 50000)),
+        keys=bool(opts.get("keys", False)))
+
+
+def field_options_to_json(o):
+    out = {"type": o.type, "keys": o.keys}
+    if o.type == FIELD_TYPE_INT:
+        out.update({"min": o.min, "max": o.max, "base": o.base,
+                    "bitDepth": o.bit_depth})
+    elif o.type == FIELD_TYPE_TIME:
+        out.update({"timeQuantum": o.time_quantum,
+                    "noStandardView": o.no_standard_view})
+    else:
+        out.update({"cacheType": o.cache_type, "cacheSize": o.cache_size})
+    return out
+
+
+def result_to_json(result):
+    """Encode one executor result in the reference's QueryResponse JSON
+    shape (reference: QueryResponse.MarshalJSON handler.go:61,
+    Row.MarshalJSON row.go:303)."""
+    from ..core.row import Row
+    from ..exec.result import GroupCount, Pair, RowIdentifiers, ValCount
+
+    if isinstance(result, Row):
+        out = {"attrs": result.attrs or {},
+               "columns": [int(c) for c in result.columns()]}
+        if result.keys is not None:
+            out["keys"] = result.keys
+        return out
+    if isinstance(result, list):
+        return [result_to_json(r) for r in result]
+    if isinstance(result, (ValCount, Pair, RowIdentifiers, GroupCount)):
+        return result.to_json()
+    if result is None or isinstance(result, (bool, int, float, str, dict)):
+        return result
+    raise ApiError(f"unencodable result type {type(result)!r}")
+
+
+class API:
+    def __init__(self, holder, cluster=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.executor = Executor(holder)
+
+    # -- queries ------------------------------------------------------------
+
+    def query(self, index_name, pql, shards=None, options=None):
+        """(reference: api.Query api.go:135)"""
+        if self.holder.index(index_name) is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        try:
+            query = parse(pql) if isinstance(pql, str) else pql
+            results = self.executor.execute(
+                index_name, query, shards=shards, options=options)
+        except (ApiError,):
+            raise
+        except Exception as e:
+            raise ApiError(str(e)) from e
+        return results
+
+    # -- schema DDL ---------------------------------------------------------
+
+    def create_index(self, name, options=None):
+        from ..core.holder import HolderError
+        from ..core.index import IndexError_
+
+        try:
+            idx = self.holder.create_index(name, options=options)
+        except HolderError as e:
+            raise ConflictError(str(e)) from e
+        except IndexError_ as e:
+            raise ApiError(str(e)) from e
+        self._broadcast_schema()
+        return idx
+
+    def delete_index(self, name):
+        from ..core.holder import HolderError
+
+        try:
+            self.holder.delete_index(name)
+        except HolderError as e:
+            raise NotFoundError(str(e)) from e
+        self._broadcast_schema()
+
+    def create_field(self, index_name, field_name, options=None):
+        from ..core.index import IndexError_
+
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        try:
+            field = idx.create_field(field_name, options=options)
+        except IndexError_ as e:
+            if "already exists" in str(e):
+                raise ConflictError(str(e)) from e
+            raise ApiError(str(e)) from e
+        self._broadcast_schema()
+        return field
+
+    def delete_field(self, index_name, field_name):
+        from ..core.index import IndexError_
+
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        try:
+            idx.delete_field(field_name)
+        except IndexError_ as e:
+            raise NotFoundError(str(e)) from e
+        self._broadcast_schema()
+
+    def schema(self):
+        """Public schema in the reference's camelCase wire shape
+        (reference: handleGetSchema + FieldOptions.MarshalJSON)."""
+        out = []
+        for iname in sorted(self.holder.indexes):
+            idx = self.holder.indexes[iname]
+            fields = []
+            for fname in sorted(idx.public_fields()):
+                f = idx.fields[fname]
+                fields.append({
+                    "name": fname,
+                    "options": field_options_to_json(f.options),
+                    "shards": f.available_shards(),
+                })
+            out.append({
+                "name": iname,
+                "options": {"keys": idx.options.keys,
+                            "trackExistence": idx.options.track_existence},
+                "fields": fields,
+            })
+        return {"indexes": out}
+
+    def apply_schema(self, schema):
+        """Accepts the camelCase wire shape (reference: handlePostSchema)."""
+        for idx_desc in schema.get("indexes", []):
+            opts = idx_desc.get("options", {})
+            idx = self.holder.create_index(
+                idx_desc["name"],
+                options=IndexOptions(
+                    keys=bool(opts.get("keys", False)),
+                    track_existence=bool(opts.get("trackExistence", True))),
+                if_not_exists=True)
+            for f_desc in idx_desc.get("fields", []):
+                idx.create_field(
+                    f_desc["name"],
+                    options=field_options_from_json(f_desc.get("options")),
+                    if_not_exists=True)
+
+    def _broadcast_schema(self):
+        if self.cluster is not None:
+            self.cluster.broadcast_schema(self.holder.schema())
+
+    # -- imports ------------------------------------------------------------
+
+    def import_bits(self, index_name, field_name, row_ids, column_ids,
+                    timestamps=None, clear=False):
+        """(reference: api.Import api.go:920)"""
+        field = self._field(index_name, field_name)
+        changed = field.import_bits(
+            row_ids, column_ids, timestamps=timestamps, clear=clear)
+        self.holder.index(index_name).add_existence(column_ids)
+        return changed
+
+    def import_values(self, index_name, field_name, column_ids, values):
+        field = self._field(index_name, field_name)
+        changed = field.import_values(column_ids, values)
+        self.holder.index(index_name).add_existence(column_ids)
+        return changed
+
+    def import_roaring(self, index_name, field_name, shard, data,
+                       clear=False, view="standard"):
+        """(reference: api.ImportRoaring api.go:368 — fastest ingest)"""
+        field = self._field(index_name, field_name)
+        v = field.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(int(shard))
+        return frag.import_roaring(data, clear=clear)
+
+    def _field(self, index_name, field_name):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        field = idx.field(field_name)
+        if field is None:
+            raise NotFoundError(f"field not found: {field_name}")
+        return field
+
+    # -- export -------------------------------------------------------------
+
+    def export_csv(self, index_name, field_name, shard):
+        """(reference: api.ExportCSV api.go:500) row,col lines for one
+        shard."""
+        field = self._field(index_name, field_name)
+        view = field.view()
+        frag = view.fragment(int(shard)) if view else None
+        buf = io.StringIO()
+        writer = csv.writer(buf)
+        if frag is not None:
+            for row_id in frag.row_ids():
+                for col in frag.row_columns(row_id):
+                    writer.writerow([row_id, int(col)])
+        return buf.getvalue()
+
+    # -- info/status --------------------------------------------------------
+
+    def info(self):
+        return {"shardWidth": SHARD_WIDTH, "version": __version__}
+
+    def status(self):
+        state = "NORMAL"
+        nodes = []
+        if self.cluster is not None:
+            state = self.cluster.state
+            nodes = self.cluster.nodes_json()
+        else:
+            nodes = [{"id": "local", "uri": {"scheme": "http"},
+                      "isCoordinator": True, "state": "READY"}]
+        return {"state": state, "nodes": nodes,
+                "localShardWidth": SHARD_WIDTH}
+
+    def shards_max(self):
+        out = {}
+        for name, idx in self.holder.indexes.items():
+            shards = idx.available_shards()
+            out[name] = shards[-1] if shards else 0
+        return {"standard": out}
+
+    def recalculate_caches(self):
+        return None
+
+    def hosts(self):
+        if self.cluster is not None:
+            return self.cluster.nodes_json()
+        return [{"id": "local", "isCoordinator": True}]

@@ -1,0 +1,114 @@
+"""HTTP client (reference: http/client.go InternalClient).
+
+Used by applications, the CLI import/export commands, and node-to-node
+data-plane RPC in the cluster layer. stdlib urllib; no external deps."""
+
+import json
+import urllib.error
+import urllib.request
+
+
+class ClientError(Exception):
+    def __init__(self, status, message):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class Client:
+    def __init__(self, base_url, timeout=30):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method, path, body=None, content_type="application/json"):
+        req = urllib.request.Request(
+            self.base_url + path, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise ClientError(e.code, message) from e
+        if ctype.startswith("application/json"):
+            return json.loads(data.decode()) if data else None
+        return data
+
+    # -- schema --------------------------------------------------------------
+
+    def create_index(self, name, keys=False, track_existence=True):
+        return self._request("POST", f"/index/{name}", json.dumps({
+            "options": {"keys": keys, "trackExistence": track_existence},
+        }).encode())
+
+    def delete_index(self, name):
+        return self._request("DELETE", f"/index/{name}")
+
+    def create_field(self, index, field, options=None):
+        return self._request(
+            "POST", f"/index/{index}/field/{field}",
+            json.dumps({"options": options or {}}).encode())
+
+    def delete_field(self, index, field):
+        return self._request("DELETE", f"/index/{index}/field/{field}")
+
+    def schema(self):
+        return self._request("GET", "/schema")
+
+    # -- queries -------------------------------------------------------------
+
+    def query(self, index, pql, shards=None):
+        """(reference: InternalClient.QueryNode http/client.go:268)"""
+        path = f"/index/{index}/query"
+        if shards is not None:
+            path += "?shards=" + ",".join(str(s) for s in shards)
+        return self._request(
+            "POST", path, pql.encode(), content_type="text/plain")
+
+    # -- imports -------------------------------------------------------------
+
+    def import_bits(self, index, field, row_ids, column_ids,
+                    timestamps=None, clear=False):
+        path = f"/index/{index}/field/{field}/import"
+        if clear:
+            path += "?clear=true"
+        body = {"rowIDs": [int(r) for r in row_ids],
+                "columnIDs": [int(c) for c in column_ids]}
+        if timestamps is not None:
+            body["timestamps"] = timestamps
+        return self._request("POST", path, json.dumps(body).encode())
+
+    def import_values(self, index, field, column_ids, values):
+        path = f"/index/{index}/field/{field}/import"
+        body = {"columnIDs": [int(c) for c in column_ids],
+                "values": [int(v) for v in values]}
+        return self._request("POST", path, json.dumps(body).encode())
+
+    def import_roaring(self, index, field, shard, data, clear=False,
+                       view="standard"):
+        path = (f"/index/{index}/field/{field}/import-roaring/{shard}"
+                f"?view={view}")
+        if clear:
+            path += "&clear=true"
+        return self._request(
+            "POST", path, data, content_type="application/octet-stream")
+
+    # -- misc ----------------------------------------------------------------
+
+    def status(self):
+        return self._request("GET", "/status")
+
+    def info(self):
+        return self._request("GET", "/info")
+
+    def export_csv(self, index, field, shard):
+        data = self._request(
+            "GET", f"/export?index={index}&field={field}&shard={shard}")
+        return data.decode() if isinstance(data, bytes) else data
+
+    def nodes(self):
+        return self._request("GET", "/internal/nodes")

@@ -1,0 +1,294 @@
+"""HTTP transport (reference: http/handler.go).
+
+Stdlib ThreadingHTTPServer + a regex router mirroring the reference's REST
+surface (route table: http/handler.go:273-322). JSON in/out using the
+reference's wire shapes; roaring imports are raw binary bodies.
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..core.index import IndexOptions
+from ..core import timeq
+from .api import ApiError, NotFoundError, field_options_from_json, \
+    field_options_to_json, result_to_json
+
+
+class Route:
+    def __init__(self, method, pattern, fn):
+        self.method = method
+        self.regex = re.compile("^" + pattern + "$")
+        self.fn = fn
+
+
+class PilosaHTTPServer:
+    """Owns the listening socket and the route table."""
+
+    def __init__(self, api, host="127.0.0.1", port=10101):
+        self.api = api
+        self.host = host
+        self.port = port
+        self.routes = self._build_routes()
+        self._httpd = None
+        self._thread = None
+
+    # -- route table (reference: http/handler.go:273-322) --------------------
+
+    def _build_routes(self):
+        a = self.api
+        return [
+            Route("GET", r"/", self._home),
+            Route("GET", r"/index", self._get_indexes),
+            Route("POST", r"/index/(?P<index>[^/]+)", self._post_index),
+            Route("GET", r"/index/(?P<index>[^/]+)", self._get_index),
+            Route("DELETE", r"/index/(?P<index>[^/]+)", self._delete_index),
+            Route("POST", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
+                  self._post_field),
+            Route("DELETE", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
+                  self._delete_field),
+            Route("POST", r"/index/(?P<index>[^/]+)/query", self._post_query),
+            Route("POST",
+                  r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
+                  self._post_import),
+            Route("POST",
+                  r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
+                  r"/import-roaring/(?P<shard>[0-9]+)",
+                  self._post_import_roaring),
+            Route("GET", r"/export", self._get_export),
+            Route("GET", r"/schema", self._get_schema),
+            Route("POST", r"/schema", self._post_schema),
+            Route("GET", r"/status", self._get_status),
+            Route("GET", r"/info", self._get_info),
+            Route("GET", r"/version", self._get_version),
+            Route("GET", r"/internal/shards/max", self._get_shards_max),
+            Route("GET", r"/internal/nodes", self._get_nodes),
+            Route("POST", r"/recalculate-caches", self._recalculate_caches),
+            Route("GET", r"/metrics", self._get_metrics),
+        ]
+
+    # -- handlers ------------------------------------------------------------
+
+    def _home(self, req):
+        return {"pilosa_tpu": "a TPU-native bitmap index",
+                "version": self.api.info()["version"]}
+
+    def _get_indexes(self, req):
+        return self.api.schema()
+
+    def _get_schema(self, req):
+        return self.api.schema()
+
+    def _post_schema(self, req):
+        self.api.apply_schema(req.json())
+        return None
+
+    def _post_index(self, req):
+        body = req.json() or {}
+        opts = body.get("options", {})
+        self.api.create_index(req.params["index"], IndexOptions(
+            keys=bool(opts.get("keys", False)),
+            track_existence=bool(opts.get("trackExistence", True))))
+        return {"success": True}
+
+    def _get_index(self, req):
+        idx = self.api.holder.index(req.params["index"])
+        if idx is None:
+            raise NotFoundError("index not found")
+        return {"name": idx.name, "options": idx.options.to_dict()}
+
+    def _delete_index(self, req):
+        self.api.delete_index(req.params["index"])
+        return {"success": True}
+
+    def _post_field(self, req):
+        body = req.json() or {}
+        options = field_options_from_json(body.get("options"))
+        self.api.create_field(req.params["index"], req.params["field"],
+                              options)
+        return {"success": True}
+
+    def _delete_field(self, req):
+        self.api.delete_field(req.params["index"], req.params["field"])
+        return {"success": True}
+
+    def _post_query(self, req):
+        from ..exec import ExecOptions
+
+        pql = req.body.decode("utf-8")
+        shards = None
+        if "shards" in req.query:
+            shards = [int(s) for s in req.query["shards"][0].split(",") if s]
+        results = self.api.query(req.params["index"], pql, shards=shards)
+        return {"results": [result_to_json(r) for r in results]}
+
+    def _post_import(self, req):
+        body = req.json()
+        if body is None:
+            raise ApiError("import requires a JSON body")
+        index, field = req.params["index"], req.params["field"]
+        clear = req.query.get("clear", ["false"])[0] == "true"
+        if "values" in body:
+            changed = self.api.import_values(
+                index, field, body.get("columnIDs", []), body["values"])
+        else:
+            timestamps = body.get("timestamps")
+            if timestamps is not None:
+                timestamps = [
+                    timeq.parse_time(t) if t else None for t in timestamps]
+            changed = self.api.import_bits(
+                index, field, body.get("rowIDs", []),
+                body.get("columnIDs", []), timestamps=timestamps, clear=clear)
+        return {"changed": changed}
+
+    def _post_import_roaring(self, req):
+        clear = req.query.get("clear", ["false"])[0] == "true"
+        view = req.query.get("view", ["standard"])[0]
+        changed = self.api.import_roaring(
+            req.params["index"], req.params["field"],
+            int(req.params["shard"]), req.body, clear=clear, view=view)
+        return {"changed": changed}
+
+    def _get_export(self, req):
+        index = req.query.get("index", [None])[0]
+        field = req.query.get("field", [None])[0]
+        shard = req.query.get("shard", ["0"])[0]
+        if not index or not field:
+            raise ApiError("index and field query params required")
+        csv_text = self.api.export_csv(index, field, int(shard))
+        return RawResponse(csv_text.encode(), "text/csv")
+
+    def _get_status(self, req):
+        return self.api.status()
+
+    def _get_info(self, req):
+        return self.api.info()
+
+    def _get_version(self, req):
+        return {"version": self.api.info()["version"]}
+
+    def _get_shards_max(self, req):
+        return self.api.shards_max()
+
+    def _get_nodes(self, req):
+        return self.api.hosts()
+
+    def _recalculate_caches(self, req):
+        self.api.recalculate_caches()
+        return None
+
+    def _get_metrics(self, req):
+        from ..utils.stats import global_stats
+
+        return RawResponse(global_stats.prometheus_text().encode(),
+                           "text/plain; version=0.0.4")
+
+    # -- server lifecycle ----------------------------------------------------
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self):
+                server.dispatch(self)
+
+            do_GET = do_POST = do_DELETE = _dispatch
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pilosa-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def address(self):
+        return f"http://{self.host}:{self.port}"
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, handler):
+        from ..utils.stats import global_stats
+
+        parsed = urlparse(handler.path)
+        path = parsed.path.rstrip("/") or "/"
+        query = parse_qs(parsed.query)
+        length = int(handler.headers.get("Content-Length", 0))
+        body = handler.rfile.read(length) if length else b""
+
+        import time as _time
+
+        t0 = _time.perf_counter()
+        status, payload, content_type = 404, {"error": "not found"}, \
+            "application/json"
+        for route in self.routes:
+            if route.method != handler.command:
+                continue
+            m = route.regex.match(path)
+            if m is None:
+                continue
+            req = Request(m.groupdict(), query, body)
+            try:
+                result = route.fn(req)
+                if isinstance(result, RawResponse):
+                    status, payload, content_type = (
+                        200, result.body, result.content_type)
+                else:
+                    status, payload = 200, result
+            except ApiError as e:
+                status, payload = e.status, {"error": str(e)}
+            except Exception as e:  # internal error
+                status, payload = 500, {"error": str(e)}
+            break
+
+        if isinstance(payload, (dict, list)) or payload is None:
+            data = json.dumps(payload).encode()
+        else:
+            data = payload
+        handler.send_response(status)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+        global_stats.timing(
+            "http_request_seconds", _time.perf_counter() - t0,
+            {"path": path, "method": handler.command,
+             "status": str(status)})
+
+
+class Request:
+    __slots__ = ("params", "query", "body")
+
+    def __init__(self, params, query, body):
+        self.params = params
+        self.query = query
+        self.body = body
+
+    def json(self):
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+
+class RawResponse:
+    __slots__ = ("body", "content_type")
+
+    def __init__(self, body, content_type):
+        self.body = body
+        self.content_type = content_type

@@ -1,0 +1,4 @@
+"""Cross-cutting utilities: stats, tracing, logging (reference: stats/,
+tracing/, logger/)."""
+
+from .stats import StatsClient, global_stats

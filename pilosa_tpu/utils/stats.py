@@ -1,0 +1,65 @@
+"""Metrics (reference: stats/stats.go StatsClient + prometheus backend).
+
+A small counter/gauge/timing registry with Prometheus text exposition —
+the reference's pluggable StatsClient collapsed to one thread-safe
+implementation with the same call surface (count/gauge/timing, tags)."""
+
+import threading
+from collections import defaultdict
+
+
+def _key(name, tags):
+    if not tags:
+        return name, ()
+    return name, tuple(sorted(tags.items()))
+
+
+class StatsClient:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = defaultdict(float)
+        self._gauges = {}
+        self._timings = defaultdict(lambda: [0, 0.0])  # count, total seconds
+
+    def count(self, name, value=1, tags=None):
+        with self._lock:
+            self._counters[_key(name, tags)] += value
+
+    def gauge(self, name, value, tags=None):
+        with self._lock:
+            self._gauges[_key(name, tags)] = value
+
+    def timing(self, name, seconds, tags=None):
+        with self._lock:
+            t = self._timings[_key(name, tags)]
+            t[0] += 1
+            t[1] += seconds
+
+    def snapshot(self):
+        with self._lock:
+            return (dict(self._counters), dict(self._gauges),
+                    {k: tuple(v) for k, v in self._timings.items()})
+
+    def prometheus_text(self):
+        """Prometheus exposition format (reference: prometheus/prometheus.go
+        + /metrics route http/handler.go:282)."""
+        counters, gauges, timings = self.snapshot()
+        lines = []
+
+        def fmt(name, labels, value):
+            if labels:
+                inner = ",".join(f'{k}="{v}"' for k, v in labels)
+                return f"{name}{{{inner}}} {value}"
+            return f"{name} {value}"
+
+        for (name, labels), value in sorted(counters.items()):
+            lines.append(fmt(f"pilosa_tpu_{name}_total", labels, value))
+        for (name, labels), value in sorted(gauges.items()):
+            lines.append(fmt(f"pilosa_tpu_{name}", labels, value))
+        for (name, labels), (count, total) in sorted(timings.items()):
+            lines.append(fmt(f"pilosa_tpu_{name}_count", labels, count))
+            lines.append(fmt(f"pilosa_tpu_{name}_sum", labels, total))
+        return "\n".join(lines) + "\n"
+
+
+global_stats = StatsClient()

@@ -1,0 +1,36 @@
+"""In-process server harness (reference: test/pilosa.go MustRunCluster —
+boots real servers on ephemeral ports)."""
+
+import tempfile
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.server import API, Client, PilosaHTTPServer
+
+
+class ServerHarness:
+    """One in-process node: holder + API + HTTP on an ephemeral port."""
+
+    def __init__(self, data_dir=None):
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="pilosa_tpu_test_")
+        self.holder = Holder(self.data_dir, use_snapshot_queue=False).open()
+        self.api = API(self.holder)
+        self.server = PilosaHTTPServer(self.api, host="127.0.0.1", port=0)
+        self.server.start()
+        self.client = Client(self.server.address)
+
+    @property
+    def address(self):
+        return self.server.address
+
+    def reopen(self):
+        """Restart from disk (reference: test/Command.Reopen)."""
+        self.server.stop()
+        self.holder.reopen()
+        self.api = API(self.holder)
+        self.server = PilosaHTTPServer(self.api, host="127.0.0.1", port=0)
+        self.server.start()
+        self.client = Client(self.server.address)
+
+    def close(self):
+        self.server.stop()
+        self.holder.close()

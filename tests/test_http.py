@@ -1,0 +1,206 @@
+"""HTTP API e2e tests — the minimum end-to-end slice (SURVEY.md §7.5):
+create index/field over HTTP, Set, Import, query, persist+reload.
+Parity model: reference http/handler tests + api_test.go + the Star Trace
+getting-started flow.
+"""
+
+import json
+
+import pytest
+
+from pilosa_tpu.roaring import Bitmap, serialize
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from .harness import ServerHarness
+
+
+@pytest.fixture
+def srv():
+    s = ServerHarness()
+    yield s
+    s.close()
+
+
+def q(srv, index, pql, **kw):
+    return srv.client.query(index, pql, **kw)["results"]
+
+
+def test_e2e_star_trace(srv):
+    """The getting-started flow (reference: docs/getting-started.md):
+    repository index, stargazer/language fields, Intersect+TopN queries."""
+    c = srv.client
+    c.create_index("repository")
+    c.create_field("repository", "stargazer", {"type": "set"})
+    c.create_field("repository", "language", {"type": "set"})
+
+    # stars: user -> repos
+    c.import_bits("repository", "stargazer",
+                  [14, 14, 14, 19, 19, 54], [1, 2, 3, 2, 10, 2])
+    # language: lang -> repos
+    c.import_bits("repository", "language", [5, 5, 5, 1], [1, 2, 3, 10])
+
+    r = q(srv, "repository", "Row(stargazer=14)")
+    assert r[0]["columns"] == [1, 2, 3]
+
+    r = q(srv, "repository",
+          "Intersect(Row(stargazer=14), Row(stargazer=19))")
+    assert r[0]["columns"] == [2]
+
+    r = q(srv, "repository", "Count(Intersect(Row(stargazer=14), Row(language=5)))")
+    assert r[0] == 3
+
+    r = q(srv, "repository", "TopN(stargazer, n=2)")
+    assert r[0] == [{"id": 14, "count": 3}, {"id": 19, "count": 2}]
+
+    r = q(srv, "repository", "Set(99, stargazer=14)")
+    assert r[0] is True
+    r = q(srv, "repository", "Row(stargazer=14)")
+    assert r[0]["columns"] == [1, 2, 3, 99]
+
+
+def test_schema_roundtrip(srv):
+    c = srv.client
+    c.create_index("i", keys=False)
+    c.create_field("i", "f", {"type": "set", "cacheType": "ranked"})
+    c.create_field("i", "n", {"type": "int", "min": -10, "max": 100})
+    c.create_field("i", "t", {"type": "time", "timeQuantum": "YM"})
+    schema = c.schema()
+    idx = next(x for x in schema["indexes"] if x["name"] == "i")
+    by_name = {f["name"]: f for f in idx["fields"]}
+    assert by_name["n"]["options"]["type"] == "int"
+    assert by_name["t"]["options"]["timeQuantum"] == "YM"
+
+    # duplicate creation conflicts
+    from pilosa_tpu.server import ClientError
+
+    with pytest.raises(ClientError) as exc:
+        c.create_index("i")
+    assert exc.value.status == 409
+    with pytest.raises(ClientError) as exc:
+        c.create_field("i", "f")
+    assert exc.value.status == 409
+
+
+def test_query_errors(srv):
+    from pilosa_tpu.server import ClientError
+
+    c = srv.client
+    with pytest.raises(ClientError) as exc:
+        c.query("nosuch", "Row(f=1)")
+    assert exc.value.status == 404
+    c.create_index("i")
+    with pytest.raises(ClientError) as exc:
+        c.query("i", "Row(")
+    assert exc.value.status == 400
+
+
+def test_bsi_over_http(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "size", {"type": "int", "min": 0, "max": 10_000})
+    c.import_values("i", "size", [1, 2, 3], [100, 2000, 30])
+    r = q(srv, "i", "Sum(field=size)")
+    assert r[0] == {"value": 2130, "count": 3}
+    r = q(srv, "i", "Row(size > 99)")
+    assert r[0]["columns"] == [1, 2]
+
+
+def test_import_roaring_over_http(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "f")
+    # row 7 bits {5, 6} in shard 1 -> positions 7*SW + offset
+    bits = [7 * SHARD_WIDTH + 5, 7 * SHARD_WIDTH + 6]
+    blob = serialize(Bitmap.from_bits(bits))
+    out = c.import_roaring("i", "f", 1, blob)
+    assert out["changed"] == 2
+    r = q(srv, "i", "Row(f=7)")
+    assert r[0]["columns"] == [SHARD_WIDTH + 5, SHARD_WIDTH + 6]
+
+
+def test_clear_import(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "f")
+    c.import_bits("i", "f", [1, 1], [5, 6])
+    c.import_bits("i", "f", [1], [5], clear=True)
+    assert q(srv, "i", "Row(f=1)")[0]["columns"] == [6]
+
+
+def test_persistence_across_restart(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "f")
+    c.query("i", "Set(3, f=1)")
+    srv.reopen()
+    assert srv.client.query("i", "Row(f=1)")["results"][0]["columns"] == [3]
+
+
+def test_export_csv(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "f")
+    c.import_bits("i", "f", [1, 2], [10, 20])
+    text = c.export_csv("i", "f", 0)
+    lines = sorted(text.strip().splitlines())
+    assert lines == ["1,10", "2,20"]
+
+
+def test_status_info_version(srv):
+    c = srv.client
+    st = c.status()
+    assert st["state"] == "NORMAL"
+    assert c.info()["shardWidth"] == SHARD_WIDTH
+    assert "version" in c._request("GET", "/version")
+
+
+def test_shards_max(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "f")
+    c.import_bits("i", "f", [1], [3 * SHARD_WIDTH + 2])
+    out = c._request("GET", "/internal/shards/max")
+    assert out["standard"]["i"] == 3
+
+
+def test_metrics_endpoint(srv):
+    c = srv.client
+    c.create_index("i")
+    data = c._request("GET", "/metrics")
+    text = data.decode() if isinstance(data, bytes) else str(data)
+    assert "pilosa_tpu_http_request_seconds_count" in text
+
+
+def test_time_quantum_over_http(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "t", {"type": "time", "timeQuantum": "YMD"})
+    c.query("i", "Set(1, t=10, 2019-01-05T00:00)")
+    c.query("i", "Set(2, t=10, 2019-06-05T00:00)")
+    r = q(srv, "i",
+          "Row(t=10, from=2019-01-01T00:00, to=2019-02-01T00:00)")
+    assert r[0]["columns"] == [1]
+
+
+def test_schema_wire_shape_camelcase(srv):
+    c = srv.client
+    c.create_index("i")
+    c.create_field("i", "n", {"type": "int", "min": 0, "max": 5})
+    schema = c.schema()
+    idx = schema["indexes"][0]
+    assert set(idx["options"]) == {"keys", "trackExistence"}
+    opts = idx["fields"][0]["options"]
+    assert "bitDepth" in opts and "base" in opts
+
+
+def test_post_schema_applies(srv):
+    c = srv.client
+    c._request("POST", "/schema", __import__("json").dumps({
+        "indexes": [{"name": "x", "options": {"keys": False},
+                     "fields": [{"name": "f",
+                                 "options": {"type": "time",
+                                             "timeQuantum": "YMD"}}]}]
+    }).encode())
+    schema = c.schema()
+    idx = next(i for i in schema["indexes"] if i["name"] == "x")
+    assert idx["fields"][0]["options"]["timeQuantum"] == "YMD"
