@@ -92,17 +92,17 @@ class FieldOptions:
                    cache_type=CACHE_TYPE_NONE, cache_size=0)
 
     @classmethod
-    def time_field(cls, quantum, no_standard_view=False):
+    def time_field(cls, quantum, no_standard_view=False, keys=False):
         timeq.validate_quantum(quantum)
         return cls(type=FIELD_TYPE_TIME, time_quantum=quantum,
                    no_standard_view=no_standard_view,
-                   cache_type=CACHE_TYPE_NONE, cache_size=0)
+                   cache_type=CACHE_TYPE_NONE, cache_size=0, keys=keys)
 
     @classmethod
     def mutex_field(cls, cache_type=DEFAULT_CACHE_TYPE,
-                    cache_size=DEFAULT_CACHE_SIZE):
+                    cache_size=DEFAULT_CACHE_SIZE, keys=False):
         return cls(type=FIELD_TYPE_MUTEX, cache_type=cache_type,
-                   cache_size=cache_size)
+                   cache_size=cache_size, keys=keys)
 
     @classmethod
     def bool_field(cls):
@@ -121,6 +121,7 @@ class Field:
         self.snapshot_queue = snapshot_queue
         self.views = {}  # name -> View
         self.row_attr_store = row_attr_store
+        self.translate_store = None  # row key translation when keys=True
         self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -130,12 +131,21 @@ class Field:
         return os.path.join(self.path, ".meta")
 
     def open(self):
+        from ..storage import SqliteAttrStore, SqliteTranslateStore
+
         os.makedirs(self.path, exist_ok=True)
         if os.path.exists(self.meta_path):
             with open(self.meta_path) as f:
                 self.options = FieldOptions.from_dict(json.load(f))
         else:
             self.save_meta()
+        if self.row_attr_store is None:
+            self.row_attr_store = SqliteAttrStore(
+                os.path.join(self.path, ".attrs.db"))
+        if self.options.keys and self.translate_store is None:
+            self.translate_store = SqliteTranslateStore(
+                os.path.join(self.path, ".keys.db"),
+                index=self.index_name, field=self.name)
         views_dir = os.path.join(self.path, "views")
         if os.path.isdir(views_dir):
             for name in sorted(os.listdir(views_dir)):
@@ -152,6 +162,12 @@ class Field:
             for v in self.views.values():
                 v.close()
             self.views.clear()
+            if self.row_attr_store is not None:
+                self.row_attr_store.close()
+                self.row_attr_store = None
+            if self.translate_store is not None:
+                self.translate_store.close()
+                self.translate_store = None
 
     # -- views --------------------------------------------------------------
 

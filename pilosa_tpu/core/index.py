@@ -52,6 +52,7 @@ class Index:
         self.snapshot_queue = snapshot_queue
         self.fields = {}
         self.column_attr_store = column_attr_store
+        self.translate_store = None  # column key translation when keys=True
         self._row_attr_stores = row_attr_stores or {}
         self._lock = threading.RLock()
 
@@ -64,12 +65,20 @@ class Index:
         return self.options.keys
 
     def open(self):
+        from ..storage import SqliteAttrStore, SqliteTranslateStore
+
         os.makedirs(self.path, exist_ok=True)
         if os.path.exists(self.meta_path):
             with open(self.meta_path) as f:
                 self.options = IndexOptions.from_dict(json.load(f))
         else:
             self.save_meta()
+        if self.column_attr_store is None:
+            self.column_attr_store = SqliteAttrStore(
+                os.path.join(self.path, ".attrs.db"))
+        if self.options.keys and self.translate_store is None:
+            self.translate_store = SqliteTranslateStore(
+                os.path.join(self.path, ".keys.db"), index=self.name)
         for name in sorted(os.listdir(self.path)):
             sub = os.path.join(self.path, name)
             if os.path.isdir(sub) and os.path.exists(os.path.join(sub, ".meta")):
@@ -88,6 +97,12 @@ class Index:
             for f in self.fields.values():
                 f.close()
             self.fields.clear()
+            if self.column_attr_store is not None:
+                self.column_attr_store.close()
+                self.column_attr_store = None
+            if self.translate_store is not None:
+                self.translate_store.close()
+                self.translate_store = None
 
     # -- fields -------------------------------------------------------------
 

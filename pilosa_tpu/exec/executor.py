@@ -73,9 +73,19 @@ class Executor:
             query = parse(query)
         opt = options or ExecOptions()
 
+        # Key translation happens only on the coordinating node; remote
+        # shards always receive integer IDs (reference: executor.go:2610).
+        if not opt.remote:
+            from .translate import translate_calls, translate_results
+
+            translate_calls(idx, query.calls)
+
         results = []
         for call in query.calls:
             results.append(self.execute_call(idx, call, shards, opt))
+
+        if not opt.remote:
+            results = translate_results(idx, query.calls, results)
         return results
 
     def execute_call(self, idx, call, shards, opt):

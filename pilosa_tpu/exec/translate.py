@@ -1,0 +1,202 @@
+"""Executor key translation: string keys in calls -> IDs before execution,
+IDs in results -> keys after (reference: executor.go:2610-2908,
+translateCall / translateGroupByCall / translateResult).
+
+Runs only on the coordinating node (opt.remote skips it), so remote shards
+always see integer IDs — exactly the reference's contract.
+"""
+
+from ..core.field import FIELD_TYPE_BOOL
+from ..core.row import Row
+from ..pql import Call
+from .result import GroupCount, Pair, RowIdentifiers
+
+
+class TranslateError(Exception):
+    pass
+
+
+def _arg_str(call, key):
+    v = call.args.get(key)
+    return v if isinstance(v, str) else None
+
+
+def _field_arg_safe(call):
+    try:
+        return call.field_arg()
+    except ValueError:
+        return None
+
+
+def translate_calls(idx, calls):
+    for call in calls:
+        translate_call(idx, call)
+
+
+def translate_call(idx, call):
+    """(reference: executor.translateCall executor.go:2622)"""
+    name = call.name
+    col_key = row_key = field_name = None
+    if name == "SetColumnAttrs":
+        # Only the column translates; the non-underscore args are attribute
+        # names, never field/row references.
+        col_key = "_col"
+    elif name in ("Set", "Clear", "Row", "Range", "Store", "ClearRow"):
+        col_key = "_col"
+        field_name = _field_arg_safe(call)
+        row_key = field_name
+    elif name == "SetRowAttrs":
+        row_key = "_row"
+        field_name = _arg_str(call, "_field")
+    elif name == "Rows":
+        field_name = _arg_str(call, "_field")
+        row_key = "previous"
+        col_key = "column"
+    elif name == "GroupBy":
+        return _translate_group_by(idx, call)
+    else:
+        col_key = "col"
+        field_name = _arg_str(call, "field")
+        row_key = "row"
+
+    # Column key.
+    if col_key is not None and col_key in call.args:
+        value = call.args[col_key]
+        if idx.keys:
+            if not isinstance(value, str):
+                raise TranslateError(
+                    "column value must be a string when index 'keys' option"
+                    " enabled")
+            call.args[col_key] = idx.translate_store.translate_key(value)
+        elif isinstance(value, str):
+            raise TranslateError(
+                "string 'col' value not allowed unless index 'keys' option"
+                " enabled")
+
+    # Row key (only when the field exists; missing fields error downstream).
+    if field_name:
+        field = idx.field(field_name)
+        if field is None:
+            return
+        if row_key is not None and row_key in call.args:
+            value = call.args[row_key]
+            if field.type == FIELD_TYPE_BOOL:
+                # bool rows translate directly: false=0, true=1 (reference:
+                # falseRowID/trueRowID field.go)
+                if isinstance(value, bool):
+                    call.args[row_key] = 1 if value else 0
+                elif not isinstance(value, int):
+                    raise TranslateError(
+                        "bool field rows require a bool argument")
+            elif field.options.keys:
+                if not isinstance(value, str):
+                    raise TranslateError(
+                        "row value must be a string when field 'keys' option"
+                        " enabled")
+                call.args[row_key] = \
+                    field.translate_store.translate_key(value)
+            elif isinstance(value, str):
+                raise TranslateError(
+                    "string 'row' value not allowed unless field 'keys'"
+                    " option enabled")
+
+    for child in call.children:
+        translate_call(idx, child)
+
+
+def _translate_group_by(idx, call):
+    """(reference: translateGroupByCall executor.go:2718)"""
+    for child in call.children:
+        translate_call(idx, child)
+    filt = call.args.get("filter")
+    if isinstance(filt, Call):
+        translate_call(idx, filt)
+
+    previous = call.args.get("previous")
+    if previous is None:
+        return
+    if not isinstance(previous, list):
+        raise TranslateError("'previous' argument must be a list")
+    if len(call.children) != len(previous):
+        raise TranslateError(
+            f"mismatched lengths for previous: {len(previous)} and"
+            f" children: {len(call.children)}")
+    for i, child in enumerate(call.children):
+        field_name = _arg_str(child, "_field")
+        field = idx.field(field_name) if field_name else None
+        if field is None:
+            raise TranslateError(f"field not found: {field_name}")
+        prev = previous[i]
+        if field.options.keys:
+            if not isinstance(prev, str):
+                raise TranslateError(
+                    "prev value must be a string when field 'keys' option"
+                    " enabled")
+            previous[i] = field.translate_store.translate_key(prev)
+        elif isinstance(prev, str):
+            raise TranslateError(
+                f"got string row val {prev!r} in 'previous' for field"
+                f" {field.name} which doesn't use string keys")
+
+
+def translate_results(idx, calls, results):
+    return [translate_result(idx, call, result)
+            for call, result in zip(calls, results)]
+
+
+def translate_result(idx, call, result):
+    """(reference: executor.translateResult executor.go:2794)"""
+    if call.name == "Options" and call.children:
+        # result belongs to the wrapped call
+        return translate_result(idx, call.children[0], result)
+
+    if isinstance(result, Row):
+        if idx.keys:
+            cols = result.columns()
+            result.keys = idx.translate_store.translate_ids(
+                [int(c) for c in cols])
+            # keyed responses carry keys only; internal IDs don't leak
+            # (reference: translateResult builds a keys-only Row)
+            result.segments = {}
+        return result
+
+    if isinstance(result, Pair):
+        field_name = _arg_str(call, "field") or _arg_str(call, "_field")
+        if field_name:
+            field = idx.field(field_name)
+            if field is not None and field.options.keys:
+                result.key = field.translate_store.translate_id(result.id)
+        return result
+
+    if isinstance(result, list) and result and isinstance(result[0], Pair):
+        field_name = _arg_str(call, "_field") or _arg_str(call, "field")
+        if field_name:
+            field = idx.field(field_name)
+            if field is not None and field.options.keys:
+                # keyed TopN pairs carry keys only (reference drops the ID)
+                return [
+                    Pair(0, p.count,
+                         key=field.translate_store.translate_id(p.id))
+                    for p in result
+                ]
+        return result
+
+    if isinstance(result, list) and result and isinstance(result[0], GroupCount):
+        for gc in result:
+            for fr in gc.group:
+                field = idx.field(fr.field)
+                if field is not None and field.options.keys:
+                    fr.row_key = \
+                        field.translate_store.translate_id(fr.row_id)
+        return result
+
+    if isinstance(result, RowIdentifiers):
+        field_name = _arg_str(call, "_field")
+        if field_name:
+            field = idx.field(field_name)
+            if field is not None and field.options.keys:
+                result.keys = field.translate_store.translate_ids(result.rows)
+                result.rows = []
+        return result
+
+    return result

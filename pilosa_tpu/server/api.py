@@ -50,11 +50,13 @@ def field_options_from_json(opts):
     if typ == FIELD_TYPE_TIME:
         return FieldOptions.time_field(
             opts.get("timeQuantum", ""),
-            no_standard_view=bool(opts.get("noStandardView", False)))
+            no_standard_view=bool(opts.get("noStandardView", False)),
+            keys=bool(opts.get("keys", False)))
     if typ == FIELD_TYPE_MUTEX:
         return FieldOptions.mutex_field(
             cache_type=opts.get("cacheType", "ranked"),
-            cache_size=int(opts.get("cacheSize", 50000)))
+            cache_size=int(opts.get("cacheSize", 50000)),
+            keys=bool(opts.get("keys", False)))
     if typ == FIELD_TYPE_BOOL:
         return FieldOptions.bool_field()
     if typ != FIELD_TYPE_SET:

@@ -1,0 +1,28 @@
+"""Host-side durable stores (reference: boltdb/ — BoltDB-backed attribute
+and key-translation stores, attr.go, translate.go).
+
+The reference keeps these on the CPU/disk side of the system and so do we
+(SURVEY.md §2 #18/#19: "stays on CPU per north star"). SQLite replaces
+BoltDB as the embedded KV engine; the interfaces mirror the reference's
+`AttrStore` (attr.go:34) and `TranslateStore` (translate.go:35).
+"""
+
+from .attrs import AttrStore, SqliteAttrStore, MemAttrStore
+from .translate import (
+    TranslateStore,
+    SqliteTranslateStore,
+    MemTranslateStore,
+    TranslateEntry,
+    TranslateReadOnlyError,
+)
+
+__all__ = [
+    "AttrStore",
+    "SqliteAttrStore",
+    "MemAttrStore",
+    "TranslateStore",
+    "SqliteTranslateStore",
+    "MemTranslateStore",
+    "TranslateEntry",
+    "TranslateReadOnlyError",
+]

@@ -1,0 +1,172 @@
+"""Attribute stores: arbitrary K/V attributes on rows and columns.
+
+Reference: attr.go:34 (AttrStore interface), boltdb/attrstore.go:67-398
+(BoltDB impl with LRU cache and per-block checksums used by anti-entropy
+attr diffing, api.go:817-891).
+
+Semantics mirrored from the reference:
+- set_attrs MERGES into existing attrs; a None value deletes that key
+  (attr.go SetAttrs / cloneAttrs).
+- Values are str | int | float | bool | list[str].
+- blocks() returns (block_id, checksum) per 100-id block
+  (attrBlockSize attr.go:30); block_data(block) returns {id: attrs} for
+  cross-node diffing.
+"""
+
+import hashlib
+import json
+import sqlite3
+import threading
+
+ATTR_BLOCK_SIZE = 100  # reference: attrBlockSize attr.go:30
+_CACHE_SIZE = 8192     # reference: attrCacheSize boltdb/attrstore.go
+
+
+def _validate_attrs(attrs):
+    for k, v in attrs.items():
+        if not isinstance(k, str):
+            raise TypeError(f"attr key must be str: {k!r}")
+        if v is None:
+            continue
+        if isinstance(v, (str, bool, int, float)):
+            continue
+        if isinstance(v, list) and all(isinstance(x, str) for x in v):
+            continue
+        raise TypeError(f"unsupported attr value for {k!r}: {v!r}")
+
+
+def _merge(existing, updates):
+    out = dict(existing)
+    for k, v in updates.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = v
+    return out
+
+
+def _checksum(items):
+    """Checksum over sorted (id, canonical-json attrs) pairs."""
+    h = hashlib.blake2b(digest_size=8)
+    for id, attrs in sorted(items):
+        h.update(str(id).encode())
+        h.update(json.dumps(attrs, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+class AttrStore:
+    """Abstract store (reference: AttrStore attr.go:34)."""
+
+    def attrs(self, id):
+        raise NotImplementedError
+
+    def set_attrs(self, id, attrs):
+        raise NotImplementedError
+
+    def set_bulk_attrs(self, attr_map):
+        for id, attrs in attr_map.items():
+            self.set_attrs(id, attrs)
+
+    def all_items(self):
+        raise NotImplementedError
+
+    def blocks(self):
+        """[(block_id, checksum)] for every non-empty 100-id block."""
+        by_block = {}
+        for id, attrs in self.all_items():
+            by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append((id, attrs))
+        return sorted(
+            (b, _checksum(items)) for b, items in by_block.items())
+
+    def block_data(self, block_id):
+        lo = block_id * ATTR_BLOCK_SIZE
+        hi = lo + ATTR_BLOCK_SIZE
+        return {id: attrs for id, attrs in self.all_items() if lo <= id < hi}
+
+    def close(self):
+        pass
+
+
+class SqliteAttrStore(AttrStore):
+    """SQLite-backed store with a small read cache."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.RLock()
+        self._cache = {}
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS attrs ("
+            " id INTEGER PRIMARY KEY, data TEXT NOT NULL)")
+        self._db.commit()
+
+    def attrs(self, id):
+        id = int(id)
+        with self._lock:
+            hit = self._cache.get(id)
+            if hit is not None:
+                return dict(hit)
+            row = self._db.execute(
+                "SELECT data FROM attrs WHERE id=?", (id,)).fetchone()
+            attrs = json.loads(row[0]) if row is not None else {}
+            if len(self._cache) >= _CACHE_SIZE:
+                self._cache.clear()
+            self._cache[id] = attrs
+        return dict(attrs)
+
+    def set_attrs(self, id, attrs):
+        _validate_attrs(attrs)
+        id = int(id)
+        with self._lock:
+            merged = _merge(self.attrs(id), attrs)
+            self._db.execute(
+                "INSERT OR REPLACE INTO attrs(id, data) VALUES (?, ?)",
+                (id, json.dumps(merged, sort_keys=True)))
+            self._db.commit()
+            self._cache[id] = merged
+        return merged
+
+    def set_bulk_attrs(self, attr_map):
+        with self._lock:
+            for id, attrs in attr_map.items():
+                _validate_attrs(attrs)
+                merged = _merge(self.attrs(int(id)), attrs)
+                self._db.execute(
+                    "INSERT OR REPLACE INTO attrs(id, data) VALUES (?, ?)",
+                    (int(id), json.dumps(merged, sort_keys=True)))
+                self._cache[int(id)] = merged
+            self._db.commit()
+
+    def all_items(self):
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT id, data FROM attrs ORDER BY id").fetchall()
+        return [(int(id), json.loads(data)) for id, data in rows]
+
+    def close(self):
+        with self._lock:
+            self._db.close()
+
+
+class MemAttrStore(AttrStore):
+    """In-memory store (tests / cache-less mode)."""
+
+    def __init__(self):
+        self._data = {}
+        self._lock = threading.RLock()
+
+    def attrs(self, id):
+        with self._lock:
+            return dict(self._data.get(int(id), {}))
+
+    def set_attrs(self, id, attrs):
+        _validate_attrs(attrs)
+        with self._lock:
+            merged = _merge(self._data.get(int(id), {}), attrs)
+            self._data[int(id)] = merged
+        return merged
+
+    def all_items(self):
+        with self._lock:
+            return sorted((i, dict(a)) for i, a in self._data.items())

@@ -1,0 +1,247 @@
+"""Key translation: string key <-> uint64 ID, per-index (columns) and
+per-field (rows).
+
+Reference: translate.go:35-70 (TranslateStore interface), boltdb/translate.go
+(BoltDB impl, monotonic IDs from a bucket sequence), holder.go:702-880
+(primary -> replica streaming replication via TranslateEntryReader).
+
+TPU-native design: translation is pure host-side metadata (IDs are what land
+on device planes), so the store is an embedded SQLite table with an
+autoincrementing rowid — the same monotonic-allocation semantics as the
+reference's bucket sequence. Replication uses `entries(offset)` which
+yields (id, key) pairs in ID order, the same contract as the reference's
+EntryReader (boltdb/translate.go:290).
+
+Writes are only legal on the primary; replicas mark the store read-only and
+raise TranslateReadOnlyError so callers redirect to the primary (reference:
+ErrTranslateStoreReadOnly, http/handler.go:518-522).
+"""
+
+import sqlite3
+import threading
+
+
+class TranslateReadOnlyError(Exception):
+    """Raised when a key would be created on a read-only (replica) store."""
+
+
+class TranslateEntry:
+    """One key/ID pair in the replication stream (reference:
+    TranslateEntry translate.go:73)."""
+
+    __slots__ = ("index", "field", "id", "key")
+
+    def __init__(self, index="", field="", id=0, key=""):
+        self.index = index
+        self.field = field
+        self.id = id
+        self.key = key
+
+    def to_json(self):
+        out = {"id": self.id, "key": self.key}
+        if self.index:
+            out["index"] = self.index
+        if self.field:
+            out["field"] = self.field
+        return out
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(index=d.get("index", ""), field=d.get("field", ""),
+                   id=d["id"], key=d["key"])
+
+    def __repr__(self):
+        return f"TranslateEntry({self.index}/{self.field}: {self.id}={self.key!r})"
+
+
+class TranslateStore:
+    """Abstract store (reference: TranslateStore translate.go:35)."""
+
+    def __init__(self, index="", field=""):
+        self.index = index
+        self.field = field
+        self._read_only = False
+
+    # -- read-only flag ------------------------------------------------------
+
+    @property
+    def read_only(self):
+        return self._read_only
+
+    def set_read_only(self, v):
+        self._read_only = bool(v)
+
+    # -- interface -----------------------------------------------------------
+
+    def max_id(self):
+        raise NotImplementedError
+
+    def translate_key(self, key, create=True):
+        """key -> id, allocating a new monotonic id when absent (unless the
+        store is read-only or create=False). Returns None when absent and
+        not created."""
+        return self.translate_keys([key], create=create)[0]
+
+    def translate_keys(self, keys, create=True):
+        raise NotImplementedError
+
+    def translate_id(self, id):
+        return self.translate_ids([id])[0]
+
+    def translate_ids(self, ids):
+        raise NotImplementedError
+
+    def force_set(self, id, key):
+        """Write a key/id pair even when read-only (replication apply)."""
+        raise NotImplementedError
+
+    def entries(self, offset=0):
+        """Yield TranslateEntry for every pair with id > offset, in id
+        order (replication read side)."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class SqliteTranslateStore(TranslateStore):
+    """SQLite-backed store; one file per (index[, field]).
+
+    IDs allocate from 1 monotonically (INTEGER PRIMARY KEY AUTOINCREMENT
+    never reuses rowids, matching the reference's bucket sequence)."""
+
+    def __init__(self, path, index="", field=""):
+        super().__init__(index, field)
+        self.path = path
+        self._lock = threading.RLock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS keys ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " key TEXT NOT NULL UNIQUE)")
+        self._db.commit()
+
+    def max_id(self):
+        with self._lock:
+            row = self._db.execute("SELECT MAX(id) FROM keys").fetchone()
+        return int(row[0] or 0)
+
+    def translate_keys(self, keys, create=True):
+        for key in keys:
+            if not isinstance(key, str):
+                raise TypeError(f"translate key must be str: {key!r}")
+        out = []
+        with self._lock:
+            created = False
+            try:
+                for key in keys:
+                    row = self._db.execute(
+                        "SELECT id FROM keys WHERE key=?", (key,)).fetchone()
+                    if row is not None:
+                        out.append(int(row[0]))
+                        continue
+                    if not create:
+                        out.append(None)
+                        continue
+                    if self._read_only:
+                        raise TranslateReadOnlyError(
+                            f"translate store read only:"
+                            f" {self.index}/{self.field}")
+                    cur = self._db.execute(
+                        "INSERT INTO keys(key) VALUES (?)", (key,))
+                    out.append(int(cur.lastrowid))
+                    created = True
+            except BaseException:
+                if created:
+                    self._db.rollback()
+                raise
+            if created:
+                self._db.commit()
+        return out
+
+    def translate_ids(self, ids):
+        out = []
+        with self._lock:
+            for id in ids:
+                row = self._db.execute(
+                    "SELECT key FROM keys WHERE id=?", (int(id),)).fetchone()
+                out.append(row[0] if row is not None else None)
+        return out
+
+    def force_set(self, id, key):
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO keys(id, key) VALUES (?, ?)",
+                (int(id), key))
+            # keep AUTOINCREMENT's high-water mark >= id so future local
+            # allocations (if ever promoted to primary) don't collide
+            self._db.execute(
+                "UPDATE sqlite_sequence SET seq=MAX(seq, ?) WHERE name='keys'",
+                (int(id),))
+            self._db.commit()
+
+    def entries(self, offset=0):
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT id, key FROM keys WHERE id > ? ORDER BY id",
+                (int(offset),)).fetchall()
+        for id, key in rows:
+            yield TranslateEntry(self.index, self.field, int(id), key)
+
+    def close(self):
+        with self._lock:
+            self._db.close()
+
+
+class MemTranslateStore(TranslateStore):
+    """In-memory store (reference: translate.go:195-330 in-mem impl)."""
+
+    def __init__(self, index="", field=""):
+        super().__init__(index, field)
+        self._by_key = {}
+        self._by_id = {}
+        self._max = 0
+        self._lock = threading.RLock()
+
+    def max_id(self):
+        return self._max
+
+    def translate_keys(self, keys, create=True):
+        out = []
+        with self._lock:
+            for key in keys:
+                if not isinstance(key, str):
+                    raise TypeError(f"translate key must be str: {key!r}")
+                id = self._by_key.get(key)
+                if id is None:
+                    if not create:
+                        out.append(None)
+                        continue
+                    if self._read_only:
+                        raise TranslateReadOnlyError(
+                            f"translate store read only: "
+                            f"{self.index}/{self.field}")
+                    self._max += 1
+                    id = self._max
+                    self._by_key[key] = id
+                    self._by_id[id] = key
+                out.append(id)
+        return out
+
+    def translate_ids(self, ids):
+        with self._lock:
+            return [self._by_id.get(int(i)) for i in ids]
+
+    def force_set(self, id, key):
+        with self._lock:
+            self._by_key[key] = int(id)
+            self._by_id[int(id)] = key
+            self._max = max(self._max, int(id))
+
+    def entries(self, offset=0):
+        with self._lock:
+            items = sorted(
+                (i, k) for i, k in self._by_id.items() if i > offset)
+        for id, key in items:
+            yield TranslateEntry(self.index, self.field, id, key)
