@@ -1,0 +1,180 @@
+"""Per-fragment TopN row caches.
+
+Reference: cache.go — `rankCache` (sorted, threshold-pruned, :136-301) and
+`lruCache` (:58-130), selected by the field's cache type ranked/lru/none
+(field.go:1647-1649, defaults ranked/50k field.go:45-48), persisted to
+`.cache` files (fragment.go:461-502,2403) and flushed periodically
+(holder.go:506-549).
+
+TPU-native role: the dense-plane TopN recomputes exact counts on device, so
+the cache is a *candidate selector* — it bounds how many row planes get
+stacked and popcounted per TopN, exactly the approximation the reference
+makes (executor TopN consults only cached rows).
+"""
+
+import os
+import threading
+
+import numpy as np
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+DEFAULT_CACHE_SIZE = 50_000  # reference: defaultCacheSize field.go:48
+
+# Rank cache prunes when it grows past this factor of max entries
+# (reference: thresholdFactor cache.go:33).
+_PRUNE_FACTOR = 1.1
+
+
+class RankCache:
+    """Top-count cache with threshold pruning (reference: cache.go:136)."""
+
+    def __init__(self, max_entries=DEFAULT_CACHE_SIZE):
+        self.max_entries = int(max_entries)
+        self._entries = {}  # id -> count
+        self._threshold = 0
+        self._lock = threading.RLock()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def add(self, id, count):
+        id, count = int(id), int(count)
+        with self._lock:
+            if count == 0:
+                self._entries.pop(id, None)
+                return
+            if (id not in self._entries and self._threshold
+                    and count < self._threshold
+                    and len(self._entries) >= self.max_entries):
+                return  # below the pruned floor; not worth tracking
+            self._entries[id] = count
+            if len(self._entries) > self.max_entries * _PRUNE_FACTOR:
+                self._prune()
+
+    def bulk_add(self, ids, counts):
+        for id, count in zip(ids, counts):
+            self.add(id, count)
+
+    def get(self, id):
+        return self._entries.get(int(id), 0)
+
+    def ids(self):
+        """Cached row ids, highest count first (candidate order)."""
+        with self._lock:
+            return [id for id, _ in sorted(
+                self._entries.items(), key=lambda kv: (-kv[1], kv[0]))]
+
+    def top(self):
+        """[(id, count)] sorted by count desc, id asc."""
+        with self._lock:
+            return sorted(self._entries.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def invalidate(self, id):
+        with self._lock:
+            self._entries.pop(int(id), None)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._threshold = 0
+
+    def _prune(self):
+        keep = sorted(self._entries.items(), key=lambda kv: (-kv[1], kv[0]))
+        keep = keep[:self.max_entries]
+        self._entries = dict(keep)
+        self._threshold = keep[-1][1] if keep else 0
+
+
+class LRUCache:
+    """LRU row->count cache (reference: lruCache cache.go:58)."""
+
+    def __init__(self, max_entries=DEFAULT_CACHE_SIZE):
+        from collections import OrderedDict
+
+        self.max_entries = int(max_entries)
+        self._entries = OrderedDict()
+        self._lock = threading.RLock()
+
+    def __len__(self):
+        return len(self._entries)
+
+    def add(self, id, count):
+        id, count = int(id), int(count)
+        with self._lock:
+            if count == 0:
+                self._entries.pop(id, None)
+                return
+            self._entries[id] = count
+            self._entries.move_to_end(id)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def bulk_add(self, ids, counts):
+        for id, count in zip(ids, counts):
+            self.add(id, count)
+
+    def get(self, id):
+        with self._lock:
+            count = self._entries.get(int(id), 0)
+            if count:
+                self._entries.move_to_end(int(id))
+            return count
+
+    def ids(self):
+        with self._lock:
+            return [id for id, _ in sorted(
+                self._entries.items(), key=lambda kv: (-kv[1], kv[0]))]
+
+    def top(self):
+        with self._lock:
+            return sorted(self._entries.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def invalidate(self, id):
+        with self._lock:
+            self._entries.pop(int(id), None)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+def new_cache(cache_type, cache_size=DEFAULT_CACHE_SIZE):
+    """Factory by field cache type (reference: field.go:1647-1649)."""
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankCache(cache_size)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(cache_size)
+    if cache_type in (CACHE_TYPE_NONE, "", None):
+        return None
+    raise ValueError(f"unknown cache type: {cache_type!r}")
+
+
+def save_cache(cache, path):
+    """Persist (ids, counts) to a .cache file (reference:
+    fragment.flushCache fragment.go:2403 — protobuf pairs; here npz)."""
+    if cache is None or len(cache) == 0:
+        if os.path.exists(path):
+            os.remove(path)
+        return
+    pairs = cache.top()
+    ids = np.array([p[0] for p in pairs], dtype=np.uint64)
+    counts = np.array([p[1] for p in pairs], dtype=np.uint64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, ids=ids, counts=counts)
+    os.replace(tmp, path)
+
+
+def load_cache(cache, path):
+    """Load persisted pairs into cache; silently skips missing/corrupt
+    files (reference: openCache fragment.go:461 logs and continues)."""
+    if cache is None or not os.path.exists(path):
+        return
+    try:
+        with np.load(path) as data:
+            cache.bulk_add(data["ids"].tolist(), data["counts"].tolist())
+    except Exception:
+        pass

@@ -176,7 +176,9 @@ class Field:
             os.path.join(self.path, "views", name), self.index_name,
             self.name, name, max_op_n=self.max_op_n,
             snapshot_queue=self.snapshot_queue,
-            mutexed=self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL))
+            mutexed=self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL),
+            cache_type=self.options.cache_type,
+            cache_size=self.options.cache_size)
         self.views[name] = view
         return view
 

@@ -58,7 +58,10 @@ TRUE_ROW_ID = 1
 
 class Fragment:
     def __init__(self, path, index, field, view, shard,
-                 max_op_n=DEFAULT_MAX_OP_N, snapshot_queue=None, mutexed=False):
+                 max_op_n=DEFAULT_MAX_OP_N, snapshot_queue=None, mutexed=False,
+                 cache_type="none", cache_size=0):
+        from .cache import new_cache
+
         self.path = path
         self.index = index
         self.field = field
@@ -67,6 +70,8 @@ class Fragment:
         self.max_op_n = max_op_n
         self.snapshot_queue = snapshot_queue
         self.mutexed = mutexed
+        # TopN candidate cache (reference: fragment.cache fragment.go:129)
+        self.cache = new_cache(cache_type, cache_size)
 
         self.storage = Bitmap()
         self.op_n = 0
@@ -103,10 +108,41 @@ class Fragment:
                     f.write(serialize(self.storage, flags=self.flags))
             if self._file is None:  # _snapshot_locked may have opened it
                 self._file = open(self.path, "ab")
+            from .cache import load_cache
+
+            load_cache(self.cache, self.cache_path)
+            # Staleness guard: a populated fragment with an empty cache
+            # (pre-cache data dir, lost .cache file) would otherwise serve
+            # TopN from whatever rows get written next — rebuild instead.
+            if (self.cache is not None and len(self.cache) == 0
+                    and self.storage.count() > 0):
+                self.recalculate_cache()
         return self
+
+    @property
+    def cache_path(self):
+        return self.path + ".cache"
+
+    def flush_cache(self):
+        """(reference: fragment.FlushCache fragment.go:2397)"""
+        from .cache import save_cache
+
+        with self._lock:
+            save_cache(self.cache, self.cache_path)
+
+    def recalculate_cache(self):
+        """Rebuild cached counts from storage (reference:
+        fragment.RecalculateCache fragment.go:2389)."""
+        if self.cache is None:
+            return
+        with self._lock:
+            self.cache.clear()
+            for row_id in self.row_ids():
+                self.cache.add(row_id, self.row_count(row_id))
 
     def close(self):
         with self._lock:
+            self.flush_cache()
             if self._file:
                 self._file.close()
                 self._file = None
@@ -139,6 +175,7 @@ class Fragment:
         if changed:
             self._append_op(encode_op(OP_ADD, value=pos))
             self._invalidate_row(row_id)
+            self._cache_update(row_id)
         return changed
 
     def clear_bit(self, row_id, column_id):
@@ -151,6 +188,7 @@ class Fragment:
         if changed:
             self._append_op(encode_op(OP_REMOVE, value=pos))
             self._invalidate_row(row_id)
+            self._cache_update(row_id)
         return changed
 
     def _handle_mutex(self, row_id, column_id):
@@ -254,6 +292,15 @@ class Fragment:
                     changed += n
             if changed:
                 self._invalidate_all_rows()
+                if self.cache is not None:
+                    touched = set()
+                    for arr in (to_set, to_clear):
+                        if len(arr):
+                            touched.update(
+                                (np.asarray(arr, dtype=np.uint64)
+                                 // np.uint64(SHARD_WIDTH)).tolist())
+                    for row_id in touched:
+                        self._cache_update(int(row_id))
             return changed
 
     def bulk_import(self, row_ids, column_ids, clear=False):
@@ -300,6 +347,11 @@ class Fragment:
                 op = OP_REMOVE_ROARING if clear else OP_ADD_ROARING
                 self._append_op(encode_op(op, roaring=serialize(other), op_n=changed))
                 self._invalidate_all_rows()
+                if self.cache is not None:
+                    touched = {
+                        key // CONTAINERS_PER_SHARD for key in other.keys()}
+                    for row_id in touched:
+                        self._cache_update(int(row_id))
             return changed
 
     # -- row planes (the device path) ----------------------------------------
@@ -372,6 +424,7 @@ class Fragment:
             self._append_op(encode_op(
                 OP_ADD_ROARING, roaring=serialize(row_bitmap), op_n=0))
             self._invalidate_row(row_id)
+            self._cache_update(row_id)
             return True
 
     # -- persistence ---------------------------------------------------------
@@ -447,6 +500,18 @@ class Fragment:
         rows = positions // np.uint64(SHARD_WIDTH)
         cols = positions % np.uint64(SHARD_WIDTH)
         return rows, cols
+
+    # -- row counts / cache ---------------------------------------------------
+
+    def row_count(self, row_id):
+        """Exact bit count of one row, from container cardinalities —
+        row ranges are container-aligned so no densification happens."""
+        return int(self.storage.count_range(
+            row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH))
+
+    def _cache_update(self, row_id):
+        if self.cache is not None:
+            self.cache.add(row_id, self.row_count(row_id))
 
     # -- stats ----------------------------------------------------------------
 

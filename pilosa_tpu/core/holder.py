@@ -66,11 +66,17 @@ class SnapshotQueue:
 
 
 class Holder:
-    def __init__(self, path, max_op_n=None, use_snapshot_queue=True):
+    def __init__(self, path, max_op_n=None, use_snapshot_queue=True,
+                 cache_flush_interval=60.0):
         self.path = path
         self.max_op_n = max_op_n
         self.indexes = {}
         self.snapshot_queue = SnapshotQueue() if use_snapshot_queue else None
+        # periodic TopN cache persistence (reference: holder.go:506-549);
+        # <=0 disables the ticker (fragments still flush on close)
+        self.cache_flush_interval = cache_flush_interval
+        self._flush_stop = None
+        self._flush_thread = None
         self._lock = threading.RLock()
         self.opened = False
 
@@ -86,11 +92,28 @@ class Holder:
             sub = os.path.join(self.path, name)
             if os.path.isdir(sub):
                 self._new_index(name).open()
+        if self.cache_flush_interval > 0:
+            self._flush_stop = threading.Event()
+            self._flush_thread = threading.Thread(
+                target=self._flush_worker, daemon=True,
+                name="cache-flush")
+            self._flush_thread.start()
         self.opened = True
         return self
 
+    def _flush_worker(self):
+        while not self._flush_stop.wait(self.cache_flush_interval):
+            try:
+                self.flush_caches()
+            except Exception:
+                pass  # flush is best-effort; fragments also flush on close
+
     def close(self):
         with self._lock:
+            if self._flush_thread is not None:
+                self._flush_stop.set()
+                self._flush_thread.join(timeout=5)
+                self._flush_thread = None
             if self.snapshot_queue:
                 self.snapshot_queue.stop()
             for idx in self.indexes.values():
@@ -103,6 +126,25 @@ class Holder:
         self.close()
         self.snapshot_queue = SnapshotQueue() if self.snapshot_queue is not None else None
         return self.open()
+
+    # -- TopN caches ---------------------------------------------------------
+
+    def _all_fragments(self):
+        for idx in list(self.indexes.values()):
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    yield from view.fragments.values()
+
+    def flush_caches(self):
+        """Persist every fragment's TopN cache (reference: holder cache
+        flush ticker holder.go:506-549)."""
+        for frag in self._all_fragments():
+            frag.flush_cache()
+
+    def recalculate_caches(self):
+        """(reference: Holder.RecalculateCaches holder.go:553)"""
+        for frag in self._all_fragments():
+            frag.recalculate_cache()
 
     # -- indexes ------------------------------------------------------------
 

@@ -16,7 +16,8 @@ VIEW_BSI_GROUP_PREFIX = "bsig_"
 
 class View:
     def __init__(self, path, index, field, name, max_op_n=None,
-                 snapshot_queue=None, mutexed=False):
+                 snapshot_queue=None, mutexed=False, cache_type="none",
+                 cache_size=0):
         self.path = path  # .../<field>/views/<name>
         self.index = index
         self.field = field
@@ -24,6 +25,10 @@ class View:
         self.mutexed = mutexed
         self.max_op_n = max_op_n
         self.snapshot_queue = snapshot_queue
+        # BSI views never cache (only row-oriented views serve TopN)
+        self.cache_type = ("none" if name.startswith(VIEW_BSI_GROUP_PREFIX)
+                           else cache_type)
+        self.cache_size = cache_size
         self.fragments = {}  # shard -> Fragment
         self._lock = threading.RLock()
 
@@ -56,6 +61,7 @@ class View:
         frag = Fragment(
             self.fragment_path(shard), self.index, self.field, self.name,
             shard, snapshot_queue=self.snapshot_queue, mutexed=self.mutexed,
+            cache_type=self.cache_type, cache_size=self.cache_size,
             **kwargs)
         self.fragments[shard] = frag
         return frag

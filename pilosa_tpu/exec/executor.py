@@ -623,16 +623,31 @@ class Executor:
     # ---------------------------------------------------------------- TopN
 
     def _exec_topn(self, idx, call, shards, opt):
-        """Exact TopN via device popcounts (the reference approximates with
-        per-fragment rank caches + heap merge, executor.go:930; dense planes
-        make the exact computation cheap)."""
+        """TopN via device popcounts over cache-selected candidates.
+
+        The reference approximates with per-fragment rank caches + heap
+        merge (executor.go:930, fragment.top fragment.go:1570); here the
+        cache bounds which row planes get stacked, then exact counts come
+        from one fused popcount dispatch. Cache-less fields fall back to an
+        exact full-row scan (a superset of reference behavior)."""
         field = self._set_field(idx, call)
         if call.children:
             self.validate_bitmap_call(idx, call.children[0])
         n = call.args.get("n")
         ids = call.args.get("ids")
         counts = self._row_counts(idx, field, call, shards,
-                                  restrict_ids=ids)
+                                  restrict_ids=ids, use_cache=ids is None)
+        # row-attribute filter (reference: attrName/attrValues
+        # executor.go:982-1005)
+        attr_name = call.args.get("attrName")
+        if attr_name is not None and field.row_attr_store is not None:
+            attr_values = call.args.get("attrValues")
+            if not isinstance(attr_values, list):
+                raise ExecError("TopN(): attrValues must be a list")
+            counts = {
+                r: c for r, c in counts.items()
+                if field.row_attr_store.attrs(r).get(attr_name) in attr_values
+            }
         pairs = [Pair(row_id, cnt) for row_id, cnt in counts.items() if cnt > 0]
         pairs.sort(key=lambda p: (-p.count, p.id))
         if n is not None and ids is None:
@@ -640,9 +655,11 @@ class Executor:
         return pairs
 
     def _row_counts(self, idx, field, call, shards, restrict_ids=None,
-                    view_name=VIEW_STANDARD):
+                    view_name=VIEW_STANDARD, use_cache=False):
         """row -> total count across shards, optionally intersected with the
-        call's first child as filter."""
+        call's first child as filter. With use_cache, candidate rows come
+        from the fragment's TopN cache when one is populated (the
+        reference's approximation: only cached rows compete)."""
         from ..ops import bitplane
         import jax.numpy as jnp
 
@@ -658,7 +675,10 @@ class Executor:
                 filt = self.bitmap_call_shard(idx, call.children[0], shard)
                 if filt is None:
                     continue  # empty filter -> zero counts in this shard
-            row_ids = frag.row_ids()
+            if use_cache and frag.cache is not None and len(frag.cache):
+                row_ids = frag.cache.ids()
+            else:
+                row_ids = frag.row_ids()
             if restrict_ids is not None:
                 wanted = {int(r) for r in restrict_ids}
                 row_ids = [r for r in row_ids if r in wanted]

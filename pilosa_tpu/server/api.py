@@ -292,6 +292,8 @@ class API:
         return {"standard": out}
 
     def recalculate_caches(self):
+        """(reference: api.RecalculateCaches api.go)"""
+        self.holder.recalculate_caches()
         return None
 
     def hosts(self):
