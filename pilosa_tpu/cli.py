@@ -45,21 +45,61 @@ def cmd_server(args):
         config["bind"] = args.bind
     if args.data_dir:
         config["data-dir"] = args.data_dir
+    if getattr(args, "cluster_hosts", None):
+        config["cluster-hosts"] = args.cluster_hosts
+    if getattr(args, "node_id", None):
+        config["node-id"] = args.node_id
+    if getattr(args, "replicas", None):
+        config["replicas"] = args.replicas
     host, _, port = config["bind"].partition(":")
     data_dir = os.path.expanduser(config["data-dir"])
 
     holder = Holder(data_dir, max_op_n=config.get("max-op-n")).open()
-    api = API(holder)
+
+    # Cluster bootstrap: static host list (the JAX-distributed model —
+    # hosts known up front; reference: gossip seeds server/config.go).
+    cluster = None
+    monitor = None
+    hosts = config.get("cluster-hosts")
+    if hosts:
+        from .cluster import Cluster, HealthMonitor, Node
+        from .server import Client
+
+        host_list = [h.strip() for h in hosts.split(",") if h.strip()]
+        nodes = []
+        for h in host_list:
+            uri = h if h.startswith("http") else f"http://{h}"
+            nodes.append(Node(id=uri.split("//", 1)[1], uri=uri))
+        # node identity: --node-id wins (needed when binding 0.0.0.0),
+        # else derived from --bind
+        local_id = config.get("node-id") or config["bind"]
+        if local_id.startswith("http"):
+            local_id = local_id.split("//", 1)[1]
+        if not any(n.id == local_id for n in nodes):
+            raise SystemExit(
+                f"node id {local_id!r} not in --cluster-hosts; pass "
+                f"--node-id matching one of the listed hosts")
+        cluster = Cluster(
+            nodes=nodes, local_id=local_id,
+            replica_n=int(config.get("replicas", 1)), path=data_dir)
+        cluster.load_topology()
+        cluster.save_topology()
+        monitor = HealthMonitor(cluster, Client).start()
+
+    api = API(holder, cluster=cluster)
     server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
     server.start()
+    extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
-          f"(data: {data_dir})", flush=True)
+          f"(data: {data_dir}{extra})", flush=True)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
     finally:
+        if monitor:
+            monitor.stop()
         server.stop()
         holder.close()
     return 0
@@ -200,6 +240,13 @@ def main(argv=None):
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("server", help="run the server daemon")
+    p.add_argument("--cluster-hosts", default=None,
+                   help="comma-separated host:port list of ALL cluster "
+                        "nodes (static bootstrap); omit for single-node")
+    p.add_argument("--node-id", default=None,
+                   help="this node's id (defaults to host:port of --bind)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replication factor (default 1)")
     p.add_argument("--bind", default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config", default=None)

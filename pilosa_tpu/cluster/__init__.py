@@ -1,0 +1,54 @@
+"""Cluster layer: node membership, shard placement, cross-node query
+fan-out, replication, anti-entropy, and resize.
+
+Reference: cluster.go (placement + resize), broadcast.go (messaging),
+gossip/ (membership). The TPU-native redesign keeps the same placement
+algebra (FNV-1a partitions + jump consistent hashing + replicaN successors)
+but replaces SWIM gossip with a static bootstrap + HTTP health monitor —
+the JAX-distributed model where hosts are known up front — and carries the
+control plane as JSON messages over HTTP (the reference's 16-type protobuf
+taxonomy, broadcast.go:55-72).
+"""
+
+from .hash import JmpHasher, ModHasher, fnv1a64, partition_hash
+from .node import (
+    Node,
+    NODE_STATE_READY,
+    NODE_STATE_DOWN,
+    CLUSTER_STATE_STARTING,
+    CLUSTER_STATE_NORMAL,
+    CLUSTER_STATE_DEGRADED,
+    CLUSTER_STATE_RESIZING,
+)
+from .cluster import Cluster, DEFAULT_PARTITION_N
+from .broadcast import (
+    MessageType,
+    Serializer,
+    NopBroadcaster,
+    HTTPBroadcaster,
+)
+from .membership import HealthMonitor
+from .executor import ClusterExecutor, result_from_json
+
+__all__ = [
+    "Cluster",
+    "ClusterExecutor",
+    "DEFAULT_PARTITION_N",
+    "HTTPBroadcaster",
+    "HealthMonitor",
+    "JmpHasher",
+    "MessageType",
+    "ModHasher",
+    "Node",
+    "NopBroadcaster",
+    "Serializer",
+    "fnv1a64",
+    "partition_hash",
+    "result_from_json",
+    "NODE_STATE_READY",
+    "NODE_STATE_DOWN",
+    "CLUSTER_STATE_STARTING",
+    "CLUSTER_STATE_NORMAL",
+    "CLUSTER_STATE_DEGRADED",
+    "CLUSTER_STATE_RESIZING",
+]

@@ -1,0 +1,340 @@
+"""Cross-node query execution: mapReduce over the cluster.
+
+Reference: executor.mapReduce (executor.go:2455) — shards are grouped by
+primary owner (shardsByNode), local shards run on this node's devices,
+remote groups are forwarded as `Remote:true` queries with an explicit shard
+list (remoteExec executor.go:2414), and responses reduce as they arrive
+(:2483-2503) with failed nodes' shards retried on their replicas.
+
+Writes route differently: Set/Clear target every replica of the owning
+shard (executor.go:2137-2160), attribute writes fan out to all nodes
+(attrs are stored on every node), and schema DDL is broadcast by the API
+layer before any of this runs.
+
+The TPU-native shape: "local shards" means shards resident in this host's
+HBM; the local reduce happens inside fused XLA dispatches (exec.Executor),
+and only per-node partial results cross the DCN as JSON.
+"""
+
+import threading
+
+from ..core.row import Row
+from ..exec.executor import ExecOptions, Executor
+from ..exec.result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount
+from ..pql import call_to_pql, parse
+from ..shardwidth import SHARD_WIDTH
+
+
+class ClusterExecError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- decoding
+
+def result_from_json(d):
+    """Decode one remote result by JSON shape (the reference decodes by
+    protobuf type tag, http/client.go QueryResponse)."""
+    if d is None or isinstance(d, (bool, int, float, str)):
+        return d
+    if isinstance(d, dict):
+        if "columns" in d or "keys" in d and "rows" not in d:
+            row = Row.from_columns(d.get("columns", []))
+            row.attrs = d.get("attrs") or None
+            row.keys = d.get("keys")
+            return row
+        if "rows" in d:
+            return RowIdentifiers(rows=d.get("rows", []), keys=d.get("keys"))
+        if "value" in d and "count" in d:
+            return ValCount(d["value"], d["count"])
+        if "id" in d and "count" in d:
+            return Pair(d["id"], d["count"], key=d.get("key"))
+        raise ClusterExecError(f"undecodable result dict: {d!r}")
+    if isinstance(d, list):
+        if not d:
+            return []
+        if isinstance(d[0], dict) and "group" in d[0]:
+            return [
+                GroupCount(
+                    [FieldRow(fr["field"], fr.get("rowID", 0),
+                              row_key=fr.get("rowKey"))
+                     for fr in gc["group"]],
+                    gc["count"])
+                for gc in d
+            ]
+        if isinstance(d[0], dict) and "id" in d[0]:
+            return [Pair(p["id"], p["count"], key=p.get("key")) for p in d]
+        raise ClusterExecError(f"undecodable result list: {d!r}")
+    raise ClusterExecError(f"undecodable result: {d!r}")
+
+
+# ---------------------------------------------------------------- reduction
+
+def reduce_results(call, a, b):
+    """Merge two per-node partial results for one call (reference: the
+    reduceFn closures in executor.go per call type)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, bool):
+        return a or b
+    if isinstance(a, (int, float)) and not isinstance(a, bool):
+        return a + b
+    if isinstance(a, Row):
+        return a.merge(b)
+    if isinstance(a, ValCount):
+        if call.name == "Min":
+            return a.smaller(b)
+        if call.name == "Max":
+            return a.larger(b)
+        return a.add(b)  # Sum
+    if isinstance(a, Pair):  # MinRow/MaxRow
+        if a.id == b.id:
+            return Pair(a.id, a.count + b.count, key=a.key)
+        if call.name == "MaxRow":
+            return a if a.id > b.id else b
+        return a if a.id < b.id else b
+    if isinstance(a, RowIdentifiers):
+        merged = sorted(set(a.rows) | set(b.rows))
+        return RowIdentifiers(rows=merged)
+    if isinstance(a, list):
+        if not a:
+            return b
+        if not b:
+            return a
+        if isinstance(a[0], Pair):  # TopN partials (Pairs.Add cache.go:356)
+            counts = {}
+            for p in a + b:
+                counts[p.id] = counts.get(p.id, 0) + p.count
+            out = [Pair(id, cnt) for id, cnt in counts.items()]
+            out.sort(key=lambda p: (-p.count, p.id))
+            return out
+        if isinstance(a[0], GroupCount):
+            totals = {}
+            for gc in a + b:
+                key = tuple((fr.field, fr.row_id) for fr in gc.group)
+                if key in totals:
+                    totals[key] = GroupCount(gc.group,
+                                             totals[key].count + gc.count)
+                else:
+                    totals[key] = gc
+            return [totals[k] for k in sorted(totals)]
+        raise ClusterExecError(f"unreducible list result: {type(a[0])}")
+    raise ClusterExecError(f"unreducible result type: {type(a)}")
+
+
+def finalize_result(call, result):
+    """Apply coordinator-side trims that remote partials skipped."""
+    if call.name == "Options" and call.children:
+        return finalize_result(call.children[0], result)
+    if isinstance(result, list) and result and isinstance(result[0], Pair):
+        n = call.args.get("n")
+        if call.name == "TopN" and n is not None \
+                and call.args.get("ids") is None:
+            return result[:int(n)]
+    if isinstance(result, list) and result \
+            and isinstance(result[0], GroupCount):
+        limit = call.args.get("limit")
+        if limit is not None:
+            return result[:int(limit)]
+    if isinstance(result, RowIdentifiers):
+        limit = call.args.get("limit")
+        if limit is not None and result.keys is None:
+            result.rows = result.rows[:int(limit)]
+    return result
+
+
+# ---------------------------------------------------------------- executor
+
+class ClusterExecutor:
+    """Coordinating executor: local device execution + remote fan-out.
+
+    Wraps exec.Executor. With a single-node cluster (or none) it degrades
+    to purely local execution."""
+
+    def __init__(self, holder, cluster, client_factory):
+        self.holder = holder
+        self.cluster = cluster
+        self.client_factory = client_factory
+        self.local = Executor(holder)
+
+    # -- public entry --------------------------------------------------------
+
+    def execute(self, index_name, query, shards=None, options=None):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise ClusterExecError(f"index not found: {index_name}")
+        if isinstance(query, str):
+            query = parse(query)
+        opt = options or ExecOptions()
+
+        if self.cluster is None or len(self.cluster.nodes) <= 1 or opt.remote:
+            # single-node, or we ARE the remote: pure local execution
+            return self.local.execute(index_name, query, shards=shards,
+                                      options=opt)
+
+        from ..exec.translate import translate_calls, translate_results
+
+        translate_calls(idx, query.calls)
+        # fetch the cluster-wide shard list ONCE per query, not per call
+        if shards is None and any(not c.writes() for c in query.calls):
+            shards = self.cluster_shards(idx)
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(idx, call, shards, opt))
+        return translate_results(idx, query.calls, results)
+
+    # -- per-call ------------------------------------------------------------
+
+    def _execute_call(self, idx, call, shards, opt):
+        if call.name in ("Set", "Clear"):
+            return self._execute_replicated_write(idx, call)
+        if call.name in ("SetRowAttrs", "SetColumnAttrs"):
+            return self._execute_attr_write(idx, call)
+        return self._map_reduce(idx, call, shards, opt)
+
+    def _remote_opt(self, opt):
+        return ExecOptions(
+            exclude_columns=opt.exclude_columns,
+            column_attrs=opt.column_attrs,
+            exclude_row_attrs=opt.exclude_row_attrs,
+            remote=True, profile=opt.profile)
+
+    def _execute_replicated_write(self, idx, call):
+        """Set/Clear: apply on every replica of the owning shard
+        (reference: executeSetBitField executor.go:2137)."""
+        col = call.args.get("_col")
+        if not isinstance(col, int) or isinstance(col, bool):
+            raise ClusterExecError(f"{call.name}() requires a column")
+        shard = col // SHARD_WIDTH
+        pql = call_to_pql(call)
+        ret = False
+        ok = 0
+        errors = []
+        for node in self.cluster.shard_nodes(idx.name, shard):
+            if node.id == self.cluster.local_id:
+                out = self.local.execute_call(
+                    idx, call, [shard], ExecOptions(remote=True))
+                ret = ret or bool(out)
+                ok += 1
+            else:
+                try:
+                    resp = self._client(node).query(
+                        idx.name, pql, remote=True)
+                    out = resp["results"][0]
+                    ret = ret or bool(out)
+                    ok += 1
+                except Exception as e:
+                    errors.append((node.id, e))
+        if ok == 0:
+            raise ClusterExecError(f"write failed on all replicas: {errors}")
+        return ret
+
+    def _execute_attr_write(self, idx, call):
+        """Attr stores live on every node — apply locally, fan out to all
+        peers (reference: executeSetRowAttrs executor.go:2212)."""
+        result = self.local.execute_call(
+            idx, call, None, ExecOptions(remote=True))
+        pql = call_to_pql(call)
+        for node in self.cluster.peers():
+            try:
+                self._client(node).query(idx.name, pql, remote=True)
+            except Exception:
+                pass  # attr divergence heals via anti-entropy attr diff
+        return result
+
+    # -- mapReduce -----------------------------------------------------------
+
+    def _map_reduce(self, idx, call, shards, opt):
+        if shards is None:
+            shards = self.cluster_shards(idx)
+        by_node = self.cluster.shards_by_node(idx.name, shards)
+
+        lock = threading.Lock()
+        merged = [None]
+        merged_any = [False]
+        errors = []
+
+        def merge_in(result):
+            with lock:
+                if not merged_any[0]:
+                    merged[0] = result
+                    merged_any[0] = True
+                else:
+                    merged[0] = reduce_results(call, merged[0], result)
+
+        def run_node(node, node_shards, tried=()):
+            try:
+                if node.id == self.cluster.local_id:
+                    result = self.local.execute_call(
+                        idx, call, node_shards, self._remote_opt(opt))
+                else:
+                    resp = self._client(node).query(
+                        idx.name, call_to_pql(call), shards=node_shards,
+                        remote=True)
+                    result = result_from_json(resp["results"][0])
+                merge_in(result)
+            except Exception as e:
+                # retry each shard on its next replica (reference:
+                # mapReduce error path executor.go:2490-2503)
+                retried = False
+                tried = tuple(tried) + (node.id,)
+                regroup = {}
+                for shard in node_shards:
+                    for replica in self.cluster.shard_nodes(idx.name, shard):
+                        if replica.id not in tried:
+                            regroup.setdefault(
+                                replica.id, (replica, []))[1].append(shard)
+                            break
+                for replica, rshards in regroup.values():
+                    retried = True
+                    run_node(replica, rshards, tried)
+                if not regroup and node_shards:
+                    with lock:
+                        errors.append((node.id, e))
+
+        threads = []
+        for node, node_shards in by_node.items():
+            t = threading.Thread(target=run_node, args=(node, node_shards))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+        if errors:
+            raise ClusterExecError(f"query failed: {errors}")
+        if not merged_any[0]:
+            # zero shards anywhere: run locally over an empty shard list so
+            # the result has the call's natural empty shape (0, empty Row…)
+            merged[0] = self.local.execute_call(
+                idx, call, [], self._remote_opt(opt))
+        return finalize_result(call, merged[0])
+
+    # -- shard discovery -----------------------------------------------------
+
+    def cluster_shards(self, idx):
+        """Union of available shards across all live nodes, fetched in
+        parallel (the reference gossips availableShards per index; here
+        it's one GET /internal/index/{i}/shards per peer, once per
+        query)."""
+        shards = set(idx.available_shards())
+        lock = threading.Lock()
+
+        def fetch(node):
+            try:
+                resp = self._client(node).index_shards(idx.name)
+                with lock:
+                    shards.update(resp.get("shards", []))
+            except Exception:
+                pass  # down node: its exclusive shards surface via retry
+
+        threads = [threading.Thread(target=fetch, args=(n,))
+                   for n in self.cluster.peers()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sorted(shards)
+
+    def _client(self, node):
+        return self.client_factory(node.uri)

@@ -650,7 +650,9 @@ class Executor:
             }
         pairs = [Pair(row_id, cnt) for row_id, cnt in counts.items() if cnt > 0]
         pairs.sort(key=lambda p: (-p.count, p.id))
-        if n is not None and ids is None:
+        # remote shards return untrimmed pairs so the coordinator's merge
+        # stays exact (reference: executeTopN trims only when !opt.Remote)
+        if n is not None and ids is None and not opt.remote:
             pairs = pairs[:int(n)]
         return pairs
 
@@ -723,7 +725,7 @@ class Executor:
         out = sorted(rows)
         if previous is not None:
             out = [r for r in out if r > int(previous)]
-        if limit is not None:
+        if limit is not None and not opt.remote:
             out = out[:int(limit)]
         return RowIdentifiers(rows=out)
 
@@ -804,7 +806,7 @@ class Executor:
                 cnt)
             for group, cnt in sorted(totals.items())
         ]
-        if limit is not None:
+        if limit is not None and not opt.remote:
             out = out[:int(limit)]
         return out
 

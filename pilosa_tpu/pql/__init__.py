@@ -14,3 +14,4 @@ from .ast import (
     is_reserved_arg,
 )
 from .parser import ParseError, parse
+from .writer import call_to_pql, query_to_pql

@@ -1,0 +1,79 @@
+"""PQL serialization: Call/Query AST -> parseable PQL text.
+
+Reference: pql.Call.String() (pql/ast.go:482). Used by the cluster layer to
+forward (already key-translated) calls to remote nodes; round-trips through
+pilosa_tpu.pql.parse.
+"""
+
+import json
+
+from .ast import BETWEEN, Call, Condition, Query
+
+
+def value_to_pql(v):
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, str):
+        return json.dumps(v)  # double-quoted, escaped
+    if isinstance(v, list):
+        return "[" + ", ".join(value_to_pql(x) for x in v) + "]"
+    if isinstance(v, Call):
+        return call_to_pql(v)
+    raise TypeError(f"cannot serialize PQL value: {v!r}")
+
+
+def _arg_to_pql(key, value):
+    if isinstance(value, Condition):
+        if value.op == BETWEEN:
+            lo, hi = value.int_values()
+            return f"{key} >< [{lo}, {hi}]"
+        return f"{key} {value.op} {value_to_pql(value.value)}"
+    return f"{key}={value_to_pql(value)}"
+
+
+def _args_to_pql(call, skip=()):
+    return [_arg_to_pql(k, v) for k, v in call.args.items() if k not in skip]
+
+
+def call_to_pql(call):
+    name = call.name
+    if name in ("Set", "Clear"):
+        parts = [value_to_pql(call.args["_col"])]
+        parts += _args_to_pql(call, skip=("_col", "_timestamp"))
+        if "_timestamp" in call.args:
+            parts.append(str(call.args["_timestamp"]))  # bare timestamp form
+        return f"{name}({', '.join(parts)})"
+    if name == "SetRowAttrs":
+        parts = [str(call.args["_field"]), value_to_pql(call.args["_row"])]
+        parts += _args_to_pql(call, skip=("_field", "_row"))
+        return f"{name}({', '.join(parts)})"
+    if name == "SetColumnAttrs":
+        parts = [value_to_pql(call.args["_col"])]
+        parts += _args_to_pql(call, skip=("_col",))
+        return f"{name}({', '.join(parts)})"
+    if name == "Store":
+        parts = [call_to_pql(call.children[0])]
+        parts += _args_to_pql(call)
+        return f"{name}({', '.join(parts)})"
+    if name in ("TopN", "Rows"):
+        parts = [str(call.args["_field"])]
+        parts += [call_to_pql(c) for c in call.children]
+        parts += _args_to_pql(call, skip=("_field",))
+        return f"{name}({', '.join(parts)})"
+    # generic: children first, then args (Row, Intersect, GroupBy, Options,
+    # Count, ClearRow, ...)
+    parts = [call_to_pql(c) for c in call.children]
+    parts += _args_to_pql(call)
+    return f"{name}({', '.join(parts)})"
+
+
+def query_to_pql(query):
+    if isinstance(query, Call):
+        return call_to_pql(query)
+    if isinstance(query, Query):
+        return "".join(call_to_pql(c) for c in query.calls)
+    raise TypeError(f"cannot serialize: {query!r}")

@@ -11,6 +11,7 @@ import csv
 
 import numpy as np
 
+from ..cluster.broadcast import MessageType, Serializer
 from ..core import FieldOptions, Holder, IndexOptions
 from ..core.field import (
     FIELD_TYPE_BOOL,
@@ -103,10 +104,18 @@ def result_to_json(result):
 
 
 class API:
-    def __init__(self, holder, cluster=None):
+    def __init__(self, holder, cluster=None, client_factory=None):
+        from ..cluster import ClusterExecutor
+
         self.holder = holder
         self.cluster = cluster
-        self.executor = Executor(holder)
+        if client_factory is None:
+            from .client import Client as client_factory  # noqa: N813
+        self.client_factory = client_factory
+        if cluster is not None:
+            self.executor = ClusterExecutor(holder, cluster, client_factory)
+        else:
+            self.executor = Executor(holder)
 
     # -- queries ------------------------------------------------------------
 
@@ -126,44 +135,54 @@ class API:
 
     # -- schema DDL ---------------------------------------------------------
 
-    def create_index(self, name, options=None):
+    def create_index(self, name, options=None, remote=False):
         from ..core.holder import HolderError
         from ..core.index import IndexError_
 
         try:
-            idx = self.holder.create_index(name, options=options)
+            idx = self.holder.create_index(
+                name, options=options, if_not_exists=remote)
         except HolderError as e:
             raise ConflictError(str(e)) from e
         except IndexError_ as e:
             raise ApiError(str(e)) from e
-        self._broadcast_schema()
+        if not remote:
+            self._broadcast(MessageType.CREATE_INDEX, {
+                "index": name,
+                "options": idx.options.to_dict()})
         return idx
 
-    def delete_index(self, name):
+    def delete_index(self, name, remote=False):
         from ..core.holder import HolderError
 
         try:
             self.holder.delete_index(name)
         except HolderError as e:
             raise NotFoundError(str(e)) from e
-        self._broadcast_schema()
+        if not remote:
+            self._broadcast(MessageType.DELETE_INDEX, {"index": name})
 
-    def create_field(self, index_name, field_name, options=None):
+    def create_field(self, index_name, field_name, options=None,
+                     remote=False):
         from ..core.index import IndexError_
 
         idx = self.holder.index(index_name)
         if idx is None:
             raise NotFoundError(f"index not found: {index_name}")
         try:
-            field = idx.create_field(field_name, options=options)
+            field = idx.create_field(
+                field_name, options=options, if_not_exists=remote)
         except IndexError_ as e:
             if "already exists" in str(e):
                 raise ConflictError(str(e)) from e
             raise ApiError(str(e)) from e
-        self._broadcast_schema()
+        if not remote:
+            self._broadcast(MessageType.CREATE_FIELD, {
+                "index": index_name, "field": field_name,
+                "options": field.options.to_dict()})
         return field
 
-    def delete_field(self, index_name, field_name):
+    def delete_field(self, index_name, field_name, remote=False):
         from ..core.index import IndexError_
 
         idx = self.holder.index(index_name)
@@ -173,7 +192,9 @@ class API:
             idx.delete_field(field_name)
         except IndexError_ as e:
             raise NotFoundError(str(e)) from e
-        self._broadcast_schema()
+        if not remote:
+            self._broadcast(MessageType.DELETE_FIELD, {
+                "index": index_name, "field": field_name})
 
     def schema(self):
         """Public schema in the reference's camelCase wire shape
@@ -213,34 +234,173 @@ class API:
                     options=field_options_from_json(f_desc.get("options")),
                     if_not_exists=True)
 
-    def _broadcast_schema(self):
-        if self.cluster is not None:
-            self.cluster.broadcast_schema(self.holder.schema())
+    def _broadcast(self, msg_type, payload, sync=True):
+        """Schema DDL fans out synchronously to every peer (reference: DDL
+        via SendSync broadcast.go / api.go)."""
+        if self.cluster is None or len(self.cluster.nodes) <= 1:
+            return
+        from ..cluster import HTTPBroadcaster
+
+        b = HTTPBroadcaster(self.cluster, self.client_factory)
+        if sync:
+            b.send_sync(msg_type, payload)
+        else:
+            b.send_async(msg_type, payload)
+
+    def receive_message(self, data):
+        """Handle one control-plane message (reference:
+        server.receiveMessage server.go:569)."""
+        msg_type, payload = Serializer.unmarshal(data)
+        if msg_type == MessageType.CREATE_INDEX:
+            self.create_index(
+                payload["index"],
+                options=IndexOptions.from_dict(payload["options"]),
+                remote=True)
+        elif msg_type == MessageType.DELETE_INDEX:
+            self.delete_index(payload["index"], remote=True)
+        elif msg_type == MessageType.CREATE_FIELD:
+            self.create_field(
+                payload["index"], payload["field"],
+                options=FieldOptions.from_dict(payload["options"]),
+                remote=True)
+        elif msg_type == MessageType.DELETE_FIELD:
+            self.delete_field(payload["index"], payload["field"], remote=True)
+        elif msg_type == MessageType.RECALCULATE_CACHES:
+            self.holder.recalculate_caches()
+        elif msg_type == MessageType.CLUSTER_STATUS:
+            if self.cluster is not None and payload.get("state"):
+                self.cluster.state = payload["state"]
+        elif msg_type == MessageType.NODE_STATE:
+            if self.cluster is not None:
+                self.cluster.set_node_state(
+                    payload["id"], payload["state"])
+        elif msg_type in (MessageType.NODE_EVENT, MessageType.NODE_STATUS,
+                          MessageType.CREATE_SHARD,
+                          MessageType.CREATE_VIEW, MessageType.DELETE_VIEW,
+                          MessageType.SET_COORDINATOR,
+                          MessageType.UPDATE_COORDINATOR,
+                          MessageType.RESIZE_INSTRUCTION,
+                          MessageType.RESIZE_INSTRUCTION_COMPLETE):
+            # handled by the server/resize layer when wired; tolerated here
+            pass
+        else:
+            raise ApiError(f"unhandled message type: {msg_type}")
 
     # -- imports ------------------------------------------------------------
 
+    def _route_import(self, index_name, shard):
+        """(local_apply, remote_nodes) for one shard's import slice
+        (reference: api.Import forwards to FragmentNodes, all replicas)."""
+        if self.cluster is None or len(self.cluster.nodes) <= 1:
+            return True, []
+        owners = self.cluster.shard_nodes(index_name, shard)
+        local = any(n.id == self.cluster.local_id for n in owners)
+        remotes = [n for n in owners if n.id != self.cluster.local_id]
+        return local, remotes
+
     def import_bits(self, index_name, field_name, row_ids, column_ids,
-                    timestamps=None, clear=False):
-        """(reference: api.Import api.go:920)"""
+                    timestamps=None, clear=False, remote=False):
+        """(reference: api.Import api.go:920 — sort bits by shard, forward
+        each slice to all replica owners)"""
         field = self._field(index_name, field_name)
-        changed = field.import_bits(
-            row_ids, column_ids, timestamps=timestamps, clear=clear)
-        self.holder.index(index_name).add_existence(column_ids)
+        if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
+            changed = field.import_bits(
+                row_ids, column_ids, timestamps=timestamps, clear=clear)
+            self.holder.index(index_name).add_existence(column_ids)
+            return changed
+
+        import numpy as np
+
+        from ..core.timeq import TIME_FORMAT
+
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        shards = column_ids // np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            mask = shards == shard
+            local, remotes = self._route_import(index_name, int(shard))
+            slice_rows = row_ids[mask]
+            slice_cols = column_ids[mask]
+            slice_ts = None
+            if timestamps is not None:
+                ts_arr = np.asarray(timestamps, dtype=object)
+                slice_ts = ts_arr[mask].tolist()
+            shard_changed = 0
+            if local:
+                shard_changed = field.import_bits(
+                    slice_rows, slice_cols, timestamps=slice_ts, clear=clear)
+                self.holder.index(index_name).add_existence(slice_cols)
+            if remotes:
+                wire_ts = None
+                if slice_ts is not None:
+                    wire_ts = [
+                        t.strftime(TIME_FORMAT) if t is not None else None
+                        for t in slice_ts]
+                for node in remotes:
+                    resp = self.client_factory(node.uri).import_bits(
+                        index_name, field_name, slice_rows.tolist(),
+                        slice_cols.tolist(), timestamps=wire_ts, clear=clear,
+                        remote=True)
+                    if not local and isinstance(resp, dict):
+                        # replicas report the same logical change count;
+                        # use it when this node didn't apply locally
+                        shard_changed = max(
+                            shard_changed, resp.get("changed", 0))
+            changed += shard_changed
         return changed
 
-    def import_values(self, index_name, field_name, column_ids, values):
+    def import_values(self, index_name, field_name, column_ids, values,
+                      remote=False):
         field = self._field(index_name, field_name)
-        changed = field.import_values(column_ids, values)
-        self.holder.index(index_name).add_existence(column_ids)
+        if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
+            changed = field.import_values(column_ids, values)
+            self.holder.index(index_name).add_existence(column_ids)
+            return changed
+
+        import numpy as np
+
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.int64)
+        shards = column_ids // np.uint64(SHARD_WIDTH)
+        changed = 0
+        for shard in np.unique(shards):
+            mask = shards == shard
+            local, remotes = self._route_import(index_name, int(shard))
+            shard_changed = 0
+            if local:
+                shard_changed = field.import_values(
+                    column_ids[mask], values[mask])
+                self.holder.index(index_name).add_existence(column_ids[mask])
+            for node in remotes:
+                resp = self.client_factory(node.uri).import_values(
+                    index_name, field_name, column_ids[mask].tolist(),
+                    values[mask].tolist(), remote=True)
+                if not local and isinstance(resp, dict):
+                    shard_changed = max(shard_changed, resp.get("changed", 0))
+            changed += shard_changed
         return changed
 
     def import_roaring(self, index_name, field_name, shard, data,
-                       clear=False, view="standard"):
-        """(reference: api.ImportRoaring api.go:368 — fastest ingest)"""
+                       clear=False, view="standard", remote=False):
+        """(reference: api.ImportRoaring api.go:368 — fastest ingest; like
+        bit imports, the blob routes to every replica owner of the shard)"""
         field = self._field(index_name, field_name)
-        v = field.create_view_if_not_exists(view)
-        frag = v.create_fragment_if_not_exists(int(shard))
-        return frag.import_roaring(data, clear=clear)
+        shard = int(shard)
+        local, remotes = (True, []) if remote else \
+            self._route_import(index_name, shard)
+        changed = 0
+        if local:
+            v = field.create_view_if_not_exists(view)
+            frag = v.create_fragment_if_not_exists(shard)
+            changed = frag.import_roaring(data, clear=clear)
+        for node in remotes:
+            resp = self.client_factory(node.uri).import_roaring(
+                index_name, field_name, shard, data, clear=clear, view=view,
+                remote=True)
+            if not local and isinstance(resp, dict):
+                changed = max(changed, resp.get("changed", 0))
+        return changed
 
     def _field(self, index_name, field_name):
         idx = self.holder.index(index_name)
@@ -294,7 +454,90 @@ class API:
     def recalculate_caches(self):
         """(reference: api.RecalculateCaches api.go)"""
         self.holder.recalculate_caches()
+        self._broadcast(MessageType.RECALCULATE_CACHES, {}, sync=False)
         return None
+
+    # -- node-to-node internals ---------------------------------------------
+
+    def index_shards(self, index_name):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        return {"shards": idx.available_shards()}
+
+    def _fragment(self, index_name, field_name, view_name, shard):
+        field = self._field(index_name, field_name)
+        view = field.view(view_name)
+        frag = view.fragment(int(shard)) if view else None
+        if frag is None:
+            raise NotFoundError(
+                f"fragment not found: {index_name}/{field_name}/"
+                f"{view_name}/{shard}")
+        return frag
+
+    def fragment_blocks(self, index_name, field_name, view_name, shard):
+        """(reference: /internal/fragment/blocks handler.go:300)"""
+        frag = self._fragment(index_name, field_name, view_name, shard)
+        return {"blocks": [{"id": bid, "checksum": chk.hex()}
+                           for bid, chk in frag.blocks()]}
+
+    def fragment_block_data(self, index_name, field_name, view_name, shard,
+                            block):
+        frag = self._fragment(index_name, field_name, view_name, shard)
+        rows, cols = frag.block_data(int(block))
+        return {"rowIDs": [int(r) for r in rows],
+                "columnIDs": [int(c) for c in cols]}
+
+    def fragment_data(self, index_name, field_name, view_name, shard):
+        """Whole fragment as a serialized roaring blob (reference:
+        /internal/fragment/data — resize streaming)."""
+        from ..roaring import serialize
+
+        frag = self._fragment(index_name, field_name, view_name, shard)
+        return serialize(frag.storage)
+
+    def translate_data(self, index_name, field_name="", offset=0):
+        """Translate-entry feed from a given ID offset (reference:
+        http/translator.go + holder.go:702-880)."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        if field_name:
+            field = idx.field(field_name)
+            if field is None:
+                raise NotFoundError(f"field not found: {field_name}")
+            store = field.translate_store
+        else:
+            store = idx.translate_store
+        if store is None:
+            return {"entries": []}
+        return {"entries": [e.to_json() for e in store.entries(int(offset))]}
+
+    def _attr_store(self, index_name, field_name=""):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        if field_name:
+            field = idx.field(field_name)
+            if field is None:
+                raise NotFoundError(f"field not found: {field_name}")
+            return field.row_attr_store
+        return idx.column_attr_store
+
+    def attr_blocks(self, index_name, field_name=""):
+        """(reference: attr diff api.go:817-891)"""
+        store = self._attr_store(index_name, field_name)
+        if store is None:
+            return {"blocks": []}
+        return {"blocks": [{"id": bid, "checksum": chk}
+                           for bid, chk in store.blocks()]}
+
+    def attr_block_data(self, index_name, field_name="", block=0):
+        store = self._attr_store(index_name, field_name)
+        if store is None:
+            return {"attrs": {}}
+        return {"attrs": {str(id): attrs for id, attrs
+                          in store.block_data(int(block)).items()}}
 
     def hosts(self):
         if self.cluster is not None:

@@ -61,39 +61,54 @@ class Client:
 
     # -- queries -------------------------------------------------------------
 
-    def query(self, index, pql, shards=None):
-        """(reference: InternalClient.QueryNode http/client.go:268)"""
+    def query(self, index, pql, shards=None, remote=False):
+        """(reference: InternalClient.QueryNode http/client.go:268; remote
+        marks node-to-node fan-out requests that must not re-fan-out)"""
         path = f"/index/{index}/query"
+        params = []
         if shards is not None:
-            path += "?shards=" + ",".join(str(s) for s in shards)
+            params.append("shards=" + ",".join(str(s) for s in shards))
+        if remote:
+            params.append("remote=true")
+        if params:
+            path += "?" + "&".join(params)
         return self._request(
             "POST", path, pql.encode(), content_type="text/plain")
 
     # -- imports -------------------------------------------------------------
 
     def import_bits(self, index, field, row_ids, column_ids,
-                    timestamps=None, clear=False):
+                    timestamps=None, clear=False, remote=False):
         path = f"/index/{index}/field/{field}/import"
+        params = []
         if clear:
-            path += "?clear=true"
+            params.append("clear=true")
+        if remote:
+            params.append("remote=true")
+        if params:
+            path += "?" + "&".join(params)
         body = {"rowIDs": [int(r) for r in row_ids],
                 "columnIDs": [int(c) for c in column_ids]}
         if timestamps is not None:
             body["timestamps"] = timestamps
         return self._request("POST", path, json.dumps(body).encode())
 
-    def import_values(self, index, field, column_ids, values):
+    def import_values(self, index, field, column_ids, values, remote=False):
         path = f"/index/{index}/field/{field}/import"
+        if remote:
+            path += "?remote=true"
         body = {"columnIDs": [int(c) for c in column_ids],
                 "values": [int(v) for v in values]}
         return self._request("POST", path, json.dumps(body).encode())
 
     def import_roaring(self, index, field, shard, data, clear=False,
-                       view="standard"):
+                       view="standard", remote=False):
         path = (f"/index/{index}/field/{field}/import-roaring/{shard}"
                 f"?view={view}")
         if clear:
             path += "&clear=true"
+        if remote:
+            path += "&remote=true"
         return self._request(
             "POST", path, data, content_type="application/octet-stream")
 
@@ -112,3 +127,53 @@ class Client:
 
     def nodes(self):
         return self._request("GET", "/internal/nodes")
+
+    # -- node-to-node internals (reference: http/client.go internal paths) ---
+
+    def index_shards(self, index):
+        """Available shards on this node (reference: availableShards
+        gossip; here an internal endpoint)."""
+        return self._request("GET", f"/internal/index/{index}/shards")
+
+    def send_message(self, data):
+        """POST a control-plane message (reference: SendMessage
+        http/client.go:1017 -> /internal/cluster/message)."""
+        return self._request(
+            "POST", "/internal/cluster/message", data,
+            content_type="application/octet-stream")
+
+    def fragment_blocks(self, index, field, view, shard):
+        """(reference: /internal/fragment/blocks handler.go:300)"""
+        return self._request(
+            "GET", f"/internal/fragment/blocks?index={index}&field={field}"
+                   f"&view={view}&shard={shard}")
+
+    def fragment_block_data(self, index, field, view, shard, block):
+        """(reference: /internal/fragment/block/data)"""
+        return self._request(
+            "GET", f"/internal/fragment/block/data?index={index}"
+                   f"&field={field}&view={view}&shard={shard}&block={block}")
+
+    def fragment_data(self, index, field, view, shard):
+        """Whole serialized fragment (reference: /internal/fragment/data,
+        used by resize streaming http/client.go:742)."""
+        return self._request(
+            "GET", f"/internal/fragment/data?index={index}&field={field}"
+                   f"&view={view}&shard={shard}")
+
+    def translate_entries(self, index, field="", offset=0):
+        """Translate-store replication feed (reference: /internal/translate/
+        data holder.go:702-880)."""
+        return self._request(
+            "GET", f"/internal/translate/data?index={index}&field={field}"
+                   f"&offset={offset}")
+
+    def attr_blocks(self, index, field=""):
+        """(reference: attr diff endpoints api.go:817-891)"""
+        return self._request(
+            "GET", f"/internal/attr/blocks?index={index}&field={field}")
+
+    def attr_block_data(self, index, field="", block=0):
+        return self._request(
+            "GET", f"/internal/attr/data?index={index}&field={field}"
+                   f"&block={block}")

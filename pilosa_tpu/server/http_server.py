@@ -65,6 +65,18 @@ class PilosaHTTPServer:
             Route("GET", r"/version", self._get_version),
             Route("GET", r"/internal/shards/max", self._get_shards_max),
             Route("GET", r"/internal/nodes", self._get_nodes),
+            Route("GET", r"/internal/index/(?P<index>[^/]+)/shards",
+                  self._get_index_shards),
+            Route("POST", r"/internal/cluster/message", self._post_message),
+            Route("GET", r"/internal/fragment/blocks",
+                  self._get_fragment_blocks),
+            Route("GET", r"/internal/fragment/block/data",
+                  self._get_fragment_block_data),
+            Route("GET", r"/internal/fragment/data", self._get_fragment_data),
+            Route("GET", r"/internal/translate/data",
+                  self._get_translate_data),
+            Route("GET", r"/internal/attr/blocks", self._get_attr_blocks),
+            Route("GET", r"/internal/attr/data", self._get_attr_block_data),
             Route("POST", r"/recalculate-caches", self._recalculate_caches),
             Route("GET", r"/metrics", self._get_metrics),
         ]
@@ -121,7 +133,11 @@ class PilosaHTTPServer:
         shards = None
         if "shards" in req.query:
             shards = [int(s) for s in req.query["shards"][0].split(",") if s]
-        results = self.api.query(req.params["index"], pql, shards=shards)
+        options = None
+        if req.query.get("remote", ["false"])[0] == "true":
+            options = ExecOptions(remote=True)
+        results = self.api.query(
+            req.params["index"], pql, shards=shards, options=options)
         return {"results": [result_to_json(r) for r in results]}
 
     def _post_import(self, req):
@@ -130,9 +146,11 @@ class PilosaHTTPServer:
             raise ApiError("import requires a JSON body")
         index, field = req.params["index"], req.params["field"]
         clear = req.query.get("clear", ["false"])[0] == "true"
+        remote = req.query.get("remote", ["false"])[0] == "true"
         if "values" in body:
             changed = self.api.import_values(
-                index, field, body.get("columnIDs", []), body["values"])
+                index, field, body.get("columnIDs", []), body["values"],
+                remote=remote)
         else:
             timestamps = body.get("timestamps")
             if timestamps is not None:
@@ -140,15 +158,18 @@ class PilosaHTTPServer:
                     timeq.parse_time(t) if t else None for t in timestamps]
             changed = self.api.import_bits(
                 index, field, body.get("rowIDs", []),
-                body.get("columnIDs", []), timestamps=timestamps, clear=clear)
+                body.get("columnIDs", []), timestamps=timestamps,
+                clear=clear, remote=remote)
         return {"changed": changed}
 
     def _post_import_roaring(self, req):
         clear = req.query.get("clear", ["false"])[0] == "true"
         view = req.query.get("view", ["standard"])[0]
+        remote = req.query.get("remote", ["false"])[0] == "true"
         changed = self.api.import_roaring(
             req.params["index"], req.params["field"],
-            int(req.params["shard"]), req.body, clear=clear, view=view)
+            int(req.params["shard"]), req.body, clear=clear, view=view,
+            remote=remote)
         return {"changed": changed}
 
     def _get_export(self, req):
@@ -174,6 +195,47 @@ class PilosaHTTPServer:
 
     def _get_nodes(self, req):
         return self.api.hosts()
+
+    def _get_index_shards(self, req):
+        return self.api.index_shards(req.params["index"])
+
+    def _post_message(self, req):
+        self.api.receive_message(req.body)
+        return None
+
+    def _q1(self, req, key, default=None):
+        return req.query.get(key, [default])[0]
+
+    def _get_fragment_blocks(self, req):
+        return self.api.fragment_blocks(
+            self._q1(req, "index"), self._q1(req, "field"),
+            self._q1(req, "view", "standard"), self._q1(req, "shard", "0"))
+
+    def _get_fragment_block_data(self, req):
+        return self.api.fragment_block_data(
+            self._q1(req, "index"), self._q1(req, "field"),
+            self._q1(req, "view", "standard"), self._q1(req, "shard", "0"),
+            self._q1(req, "block", "0"))
+
+    def _get_fragment_data(self, req):
+        data = self.api.fragment_data(
+            self._q1(req, "index"), self._q1(req, "field"),
+            self._q1(req, "view", "standard"), self._q1(req, "shard", "0"))
+        return RawResponse(data, "application/octet-stream")
+
+    def _get_translate_data(self, req):
+        return self.api.translate_data(
+            self._q1(req, "index"), self._q1(req, "field", ""),
+            int(self._q1(req, "offset", "0")))
+
+    def _get_attr_blocks(self, req):
+        return self.api.attr_blocks(
+            self._q1(req, "index"), self._q1(req, "field", ""))
+
+    def _get_attr_block_data(self, req):
+        return self.api.attr_block_data(
+            self._q1(req, "index"), self._q1(req, "field", ""),
+            int(self._q1(req, "block", "0")))
 
     def _recalculate_caches(self, req):
         self.api.recalculate_caches()

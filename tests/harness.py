@@ -34,3 +34,58 @@ class ServerHarness:
     def close(self):
         self.server.stop()
         self.holder.close()
+
+
+class ClusterHarness:
+    """n in-process nodes with a shared static topology (reference:
+    test.MustRunCluster test/pilosa.go:390 — real servers, real HTTP,
+    ephemeral ports; ModHasher optionally for deterministic placement)."""
+
+    def __init__(self, n, replica_n=1, hasher=None):
+        from pilosa_tpu.cluster import Cluster, Node
+
+        # phase 1: boot servers (cluster-less) to learn ephemeral ports
+        self.nodes = [ServerHarness() for _ in range(n)]
+        node_list = [
+            Node(id=h.address.split("//", 1)[1], uri=h.address)
+            for h in self.nodes
+        ]
+        # phase 2: attach cluster-aware APIs now that all URIs are known
+        for h in self.nodes:
+            local_id = h.address.split("//", 1)[1]
+            cluster = Cluster(
+                nodes=[Node(n_.id, n_.uri) for n_ in node_list],
+                local_id=local_id, replica_n=replica_n, hasher=hasher,
+                path=h.data_dir)
+            h.api = API(h.holder, cluster=cluster, client_factory=Client)
+            h.server.api = h.api
+            h.cluster = h.api.cluster
+
+    def __getitem__(self, i):
+        return self.nodes[i]
+
+    def __len__(self):
+        return len(self.nodes)
+
+    def owner_of(self, index, shard):
+        """The harness node that is primary owner of (index, shard)."""
+        primary = self.nodes[0].cluster.shard_nodes(index, shard)[0]
+        return self.node_by_id(primary.id)
+
+    def non_owner_of(self, index, shard):
+        owners = {n.id for n in
+                  self.nodes[0].cluster.shard_nodes(index, shard)}
+        for h in self.nodes:
+            if h.cluster.local_id not in owners:
+                return h
+        return None
+
+    def node_by_id(self, node_id):
+        for h in self.nodes:
+            if h.cluster.local_id == node_id:
+                return h
+        return None
+
+    def close(self):
+        for h in self.nodes:
+            h.close()

