@@ -89,6 +89,15 @@ def cmd_server(args):
     api = API(holder, cluster=cluster)
     server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
     server.start()
+    anti_entropy = None
+    if cluster is not None and len(cluster.nodes) > 1:
+        from .server import Client as _Client
+        from .server.syncer import AntiEntropyMonitor, HolderSyncer
+
+        interval = parse_duration(
+            config.get("anti-entropy", {}).get("interval", "10m"))
+        anti_entropy = AntiEntropyMonitor(
+            HolderSyncer(holder, cluster, _Client), interval).start()
     extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
           f"(data: {data_dir}{extra})", flush=True)
@@ -98,11 +107,29 @@ def cmd_server(args):
     except KeyboardInterrupt:
         pass
     finally:
+        if anti_entropy:
+            anti_entropy.stop()
         if monitor:
             monitor.stop()
         server.stop()
         holder.close()
     return 0
+
+
+def parse_duration(s):
+    """'10m', '30s', '500ms', '1h30m' -> seconds (reference: toml.Duration,
+    Go time.ParseDuration forms)."""
+    import re
+
+    s = str(s).strip()
+    units = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1, "m": 60, "h": 3600}
+    parts = re.findall(r"(\d+(?:\.\d+)?)(ns|us|ms|s|m|h)", s)
+    if not parts:
+        return float(s)
+    consumed = "".join(n + u for n, u in parts)
+    if consumed != s:
+        raise ValueError(f"invalid duration: {s!r}")
+    return sum(float(n) * units[u] for n, u in parts)
 
 
 def cmd_import(args):

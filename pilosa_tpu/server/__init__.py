@@ -4,3 +4,4 @@ http/, server/)."""
 from .api import API, ApiError, ConflictError, NotFoundError
 from .client import Client, ClientError
 from .http_server import PilosaHTTPServer
+from .syncer import AntiEntropyMonitor, FragmentSyncer, HolderSyncer

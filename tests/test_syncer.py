@@ -1,0 +1,198 @@
+"""Anti-entropy syncer tests (reference behavior: holder.go:911,
+fragment.go:1875,2861,2941 — majority-consensus block merge)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.server import Client, HolderSyncer
+from pilosa_tpu.server.syncer import merge_block
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from .harness import ClusterHarness
+
+
+# ---------------------------------------------------------------- merge_block
+
+
+def make_fragment(tmp_path, bits=()):
+    from pilosa_tpu.core.fragment import Fragment
+
+    frag = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    for row, col in bits:
+        frag.set_bit(row, col)
+    return frag
+
+
+def test_merge_block_union_two_replicas(tmp_path):
+    # RF=2: majority = (2+1)//2 = 1 -> union semantics (ties count as set).
+    frag = make_fragment(tmp_path, bits=[(0, 1), (0, 2)])
+    remote = ([0, 0], [2, 5])  # rows, cols: has (0,2) and (0,5)
+    deltas = merge_block(frag, 0, [remote])
+    # local gains (0,5)
+    assert frag.contains(0, 5)
+    assert frag.contains(0, 1)
+    (sets, clears), = deltas
+    assert list(sets) == [1]  # position row0*SW+1
+    assert list(clears) == []
+
+
+def test_merge_block_majority_three_replicas(tmp_path):
+    # RF=3: majority = 2. A bit on only one replica is cleared.
+    frag = make_fragment(tmp_path, bits=[(0, 1), (0, 9)])
+    r1 = ([0, 0], [1, 2])  # has (0,1),(0,2)
+    r2 = ([0], [2])        # has (0,2)
+    deltas = merge_block(frag, 0, [r1, r2])
+    # consensus: (0,1) on 2/3 -> kept; (0,2) on 2/3 -> set locally;
+    # (0,9) on 1/3 -> cleared locally.
+    assert frag.contains(0, 1)
+    assert frag.contains(0, 2)
+    assert not frag.contains(0, 9)
+    (s1, c1), (s2, c2) = deltas
+    assert list(c1) == []
+    assert list(s1) == []  # r1 already matches consensus
+    assert list(s2) == [1]  # r2 gains (0,1)
+    assert list(c2) == []
+
+
+def test_merge_block_respects_block_range(tmp_path):
+    from pilosa_tpu.core.fragment import HASH_BLOCK_SIZE
+
+    # Bits outside block 0 (row >= 100) must not be touched.
+    frag = make_fragment(tmp_path, bits=[(HASH_BLOCK_SIZE, 3), (0, 1)])
+    deltas = merge_block(frag, 0, [([], [])])
+    assert frag.contains(HASH_BLOCK_SIZE, 3)
+    (sets, clears), = deltas
+    assert list(sets) == [1]
+
+
+# ------------------------------------------------------------- cluster sync
+
+
+@pytest.fixture(scope="module")
+def cluster3():
+    c = ClusterHarness(3, replica_n=2)
+    yield c
+    c.close()
+
+
+def _local_columns(harness, index, field, row, shard=0):
+    """Row columns as seen by one node locally (no fan-out)."""
+    idx = harness.holder.index(index)
+    f = idx.field(field)
+    view = f.view()
+    frag = view.fragment(shard) if view else None
+    if frag is None:
+        return []
+    return sorted(int(c) for c in frag.row_columns(row))
+
+
+def test_sync_repairs_missing_replica(cluster3):
+    c = cluster3
+    c[0].api.create_index("aesync")
+    c[0].api.create_field("aesync", "f")
+    owners = c[0].cluster.shard_nodes("aesync", 0)
+    assert len(owners) == 2
+    a, b = c.node_by_id(owners[0].id), c.node_by_id(owners[1].id)
+
+    # Diverge: write to replica A only (remote=True applies locally only).
+    a.api.import_bits("aesync", "f", [7, 7, 8], [1, 2, 3], remote=True)
+    assert _local_columns(b, "aesync", "f", 7) == []
+
+    synced = HolderSyncer(a.holder, a.cluster, Client).sync_holder()
+    assert synced >= 1
+    assert _local_columns(b, "aesync", "f", 7) == [1, 2]
+    assert _local_columns(b, "aesync", "f", 8) == [3]
+
+
+def test_sync_is_idempotent(cluster3):
+    c = cluster3
+    owners = c[0].cluster.shard_nodes("aesync", 0)
+    a = c.node_by_id(owners[0].id)
+    syncer = HolderSyncer(a.holder, a.cluster, Client)
+    first = syncer.sync_holder()
+    again = syncer.sync_holder()
+    assert again == 0  # converged: no differing blocks
+
+
+def test_sync_attrs(cluster3):
+    c = cluster3
+    c[0].api.create_index("aeattr")
+    c[0].api.create_field("aeattr", "g")
+    # set attrs on node 0 only
+    idx0 = c[0].holder.index("aeattr")
+    idx0.column_attr_store.set_attrs(42, {"city": "sf"})
+    idx0.field("g").row_attr_store.set_attrs(7, {"label": "seven"})
+
+    # sync FROM a peer: it pulls node 0's differing attr blocks.
+    HolderSyncer(c[1].holder, c[1].cluster, Client).sync_holder()
+    idx1 = c[1].holder.index("aeattr")
+    assert idx1.column_attr_store.attrs(42) == {"city": "sf"}
+    assert idx1.field("g").row_attr_store.attrs(7) == {"label": "seven"}
+
+
+def test_unreachable_peer_does_not_clear_local_bits(cluster3):
+    """A fetch failure must abort the sync, not vote as an empty replica
+    (otherwise RF>=3 majority would clear live local bits)."""
+    from pilosa_tpu.cluster import Cluster, Node
+    from pilosa_tpu.server.syncer import FragmentSyncer
+
+    c = cluster3
+    c[0].api.create_index("aedown")
+    c[0].api.create_field("aedown", "f")
+    # three "replicas": local + two dead endpoints
+    dead = Cluster(nodes=[
+        Node(id=c[0].cluster.local_id, uri=c[0].address),
+        Node(id="dead1", uri="http://127.0.0.1:1"),
+        Node(id="dead2", uri="http://127.0.0.1:1"),
+    ], local_id=c[0].cluster.local_id, replica_n=3)
+    c[0].api.import_bits("aedown", "f", [5], [1], remote=True)
+    idx = c[0].holder.index("aedown")
+    frag = idx.field("f").view().fragment(0)
+    FragmentSyncer(frag, "aedown", dead, Client).sync_fragment()
+    assert frag.contains(5, 1)  # still there
+
+
+def test_parse_duration():
+    from pilosa_tpu.cli import parse_duration
+
+    assert parse_duration("10m") == 600
+    assert parse_duration("30s") == 30
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("1h30m") == 5400
+    assert parse_duration("1.5h") == 5400
+    assert parse_duration("45") == 45
+    with pytest.raises(ValueError):
+        parse_duration("10 bananas")
+
+
+def test_sync_full_cluster_convergence(cluster3):
+    """After syncing every node, all replicas agree on a multi-shard
+    spread of bits."""
+    c = cluster3
+    c[0].api.create_index("aeconv")
+    c[0].api.create_field("aeconv", "f")
+    rng = np.random.default_rng(3)
+    cols = rng.integers(0, 3 * SHARD_WIDTH, 200, dtype=np.uint64)
+    rows = rng.integers(0, 5, 200, dtype=np.uint64)
+    # scatter writes unevenly: each node gets a slice applied locally only
+    for i, h in enumerate(c.nodes):
+        h.api.import_bits("aeconv", "f", rows[i::3], cols[i::3], remote=True)
+
+    for h in c.nodes:
+        HolderSyncer(h.holder, h.cluster, Client).sync_holder()
+    # second pass from every node: spreads any late deltas
+    for h in c.nodes:
+        HolderSyncer(h.holder, h.cluster, Client).sync_holder()
+
+    # every shard: all owning replicas agree with the fan-out query result
+    res = c[0].api.query("aeconv", "Count(Row(f=1))")
+    want = int(res[0])
+    got_union = set()
+    for shard in range(4):
+        owners = c[0].cluster.shard_nodes("aeconv", shard)
+        per_owner = [
+            set(_local_columns(c.node_by_id(n.id), "aeconv", "f", 1, shard))
+            for n in owners]
+        assert all(p == per_owner[0] for p in per_owner)
+        got_union.update(per_owner[0])
+    assert len(got_union) == want
