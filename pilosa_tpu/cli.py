@@ -90,7 +90,7 @@ def cmd_server(args):
     server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
     server.start()
     anti_entropy = None
-    if cluster is not None and len(cluster.nodes) > 1:
+    if cluster is not None:  # even single-node: the cluster can grow
         from .server import Client as _Client
         from .server.syncer import AntiEntropyMonitor, HolderSyncer
 

@@ -29,6 +29,7 @@ from .broadcast import (
 )
 from .membership import HealthMonitor
 from .executor import ClusterExecutor, result_from_json
+from .resize import ResizeError, ResizeJob, ResizeManager, clean_holder
 
 __all__ = [
     "Cluster",
@@ -41,6 +42,10 @@ __all__ = [
     "ModHasher",
     "Node",
     "NopBroadcaster",
+    "ResizeError",
+    "ResizeJob",
+    "ResizeManager",
+    "clean_holder",
     "Serializer",
     "fnv1a64",
     "partition_hash",

@@ -113,14 +113,25 @@ class API:
             from .client import Client as client_factory  # noqa: N813
         self.client_factory = client_factory
         if cluster is not None:
+            from ..cluster import ResizeManager
+
             self.executor = ClusterExecutor(holder, cluster, client_factory)
+            self.resize = ResizeManager(holder, cluster, self.client_factory)
         else:
             self.executor = Executor(holder)
+            self.resize = None
 
     # -- queries ------------------------------------------------------------
 
+    def _validate_state(self):
+        """Most methods are forbidden while RESIZING (reference:
+        api.validate api.go:119 + apimethod_string.go)."""
+        if self.cluster is not None and self.cluster.state == "RESIZING":
+            raise ApiError("cluster is resizing; try again later")
+
     def query(self, index_name, pql, shards=None, options=None):
         """(reference: api.Query api.go:135)"""
+        self._validate_state()
         if self.holder.index(index_name) is None:
             raise NotFoundError(f"index not found: {index_name}")
         try:
@@ -267,21 +278,21 @@ class API:
             self.delete_field(payload["index"], payload["field"], remote=True)
         elif msg_type == MessageType.RECALCULATE_CACHES:
             self.holder.recalculate_caches()
-        elif msg_type == MessageType.CLUSTER_STATUS:
-            if self.cluster is not None and payload.get("state"):
-                self.cluster.state = payload["state"]
+        elif self.resize is not None and self.resize.receive(
+                msg_type, payload):
+            pass  # resize/cluster-status/coordinator handled
         elif msg_type == MessageType.NODE_STATE:
             if self.cluster is not None:
                 self.cluster.set_node_state(
                     payload["id"], payload["state"])
         elif msg_type in (MessageType.NODE_EVENT, MessageType.NODE_STATUS,
-                          MessageType.CREATE_SHARD,
+                          MessageType.CREATE_SHARD, MessageType.CLUSTER_STATUS,
                           MessageType.CREATE_VIEW, MessageType.DELETE_VIEW,
                           MessageType.SET_COORDINATOR,
                           MessageType.UPDATE_COORDINATOR,
                           MessageType.RESIZE_INSTRUCTION,
                           MessageType.RESIZE_INSTRUCTION_COMPLETE):
-            # handled by the server/resize layer when wired; tolerated here
+            # single-node mode: no resize manager; tolerated
             pass
         else:
             raise ApiError(f"unhandled message type: {msg_type}")
@@ -302,6 +313,7 @@ class API:
                     timestamps=None, clear=False, remote=False):
         """(reference: api.Import api.go:920 — sort bits by shard, forward
         each slice to all replica owners)"""
+        self._validate_state()
         field = self._field(index_name, field_name)
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
             changed = field.import_bits(
@@ -352,6 +364,7 @@ class API:
 
     def import_values(self, index_name, field_name, column_ids, values,
                       remote=False):
+        self._validate_state()
         field = self._field(index_name, field_name)
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
             changed = field.import_values(column_ids, values)
@@ -385,6 +398,7 @@ class API:
                        clear=False, view="standard", remote=False):
         """(reference: api.ImportRoaring api.go:368 — fastest ingest; like
         bit imports, the blob routes to every replica owner of the shard)"""
+        self._validate_state()
         field = self._field(index_name, field_name)
         shard = int(shard)
         local, remotes = (True, []) if remote else \
@@ -475,6 +489,21 @@ class API:
                 f"{view_name}/{shard}")
         return frag
 
+    def shard_fragments(self, index_name, shard):
+        """Every (field, view) fragment present for a shard on this node
+        (resize streaming discovery; the destination can't know which
+        views exist — they're data-dependent)."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        shard = int(shard)
+        out = []
+        for field in idx.fields.values():
+            for vname, view in field.views.items():
+                if view.fragment(shard) is not None:
+                    out.append({"field": field.name, "view": vname})
+        return {"fragments": out}
+
     def fragment_blocks(self, index_name, field_name, view_name, shard):
         """(reference: /internal/fragment/blocks handler.go:300)"""
         frag = self._fragment(index_name, field_name, view_name, shard)
@@ -543,3 +572,58 @@ class API:
         if self.cluster is not None:
             return self.cluster.nodes_json()
         return [{"id": "local", "isCoordinator": True}]
+
+    # -- resize admin (reference: api.go:1193-1267) ---------------------------
+
+    def _resize_manager(self):
+        from ..cluster import ResizeError
+
+        if self.resize is None:
+            raise ApiError("not a cluster")
+        if not self.cluster.is_coordinator():
+            coord = self.cluster.coordinator
+            raise ApiError(
+                f"not the coordinator (coordinator: "
+                f"{coord.id if coord else 'unknown'})")
+        return self.resize, ResizeError
+
+    def resize_add_node(self, node_json):
+        from ..cluster import Node
+
+        mgr, ResizeError = self._resize_manager()
+        node = Node.from_json(node_json)
+        try:
+            return mgr.add_node(node).to_json()
+        except ResizeError as e:
+            raise ApiError(str(e)) from e
+
+    def resize_remove_node(self, node_id):
+        mgr, ResizeError = self._resize_manager()
+        try:
+            return mgr.remove_node(node_id).to_json()
+        except ResizeError as e:
+            raise ApiError(str(e)) from e
+
+    def resize_abort(self):
+        mgr, ResizeError = self._resize_manager()
+        try:
+            return mgr.abort().to_json()
+        except ResizeError as e:
+            raise ApiError(str(e)) from e
+
+    def resize_status(self):
+        if self.resize is None or self.resize.job is None:
+            return {"job": None}
+        return {"job": self.resize.job.to_json()}
+
+    def set_coordinator(self, node_id):
+        """(reference: api.SetCoordinator api.go:1221)"""
+        if self.cluster is None:
+            raise ApiError("not a cluster")
+        if self.cluster.node(node_id) is None:
+            raise ApiError(f"node not in cluster: {node_id}")
+        for n in self.cluster.nodes:
+            n.is_coordinator = (n.id == node_id)
+        self.cluster.save_topology()
+        self._broadcast(MessageType.SET_COORDINATOR, {"id": node_id})
+        return {"coordinator": node_id}

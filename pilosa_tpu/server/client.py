@@ -135,6 +135,12 @@ class Client:
         gossip; here an internal endpoint)."""
         return self._request("GET", f"/internal/index/{index}/shards")
 
+    def shard_fragments(self, index, shard):
+        """(field, view) fragments a node holds for one shard (resize
+        streaming discovery)."""
+        return self._request(
+            "GET", f"/internal/index/{index}/shard/{shard}/fragments")
+
     def send_message(self, data):
         """POST a control-plane message (reference: SendMessage
         http/client.go:1017 -> /internal/cluster/message)."""
@@ -167,6 +173,29 @@ class Client:
         return self._request(
             "GET", f"/internal/translate/data?index={index}&field={field}"
                    f"&offset={offset}")
+
+    # -- resize admin (reference: /cluster/resize/* api.go:1193-1267) --------
+
+    def resize_add_node(self, node_id, uri):
+        return self._request(
+            "POST", "/cluster/resize/add-node",
+            json.dumps({"id": node_id, "uri": uri}).encode())
+
+    def resize_remove_node(self, node_id):
+        return self._request(
+            "POST", "/cluster/resize/remove-node",
+            json.dumps({"id": node_id}).encode())
+
+    def resize_abort(self):
+        return self._request("POST", "/cluster/resize/abort", b"{}")
+
+    def resize_status(self):
+        return self._request("GET", "/cluster/resize/status")
+
+    def set_coordinator(self, node_id):
+        return self._request(
+            "POST", "/cluster/resize/set-coordinator",
+            json.dumps({"id": node_id}).encode())
 
     def attr_blocks(self, index, field=""):
         """(reference: attr diff endpoints api.go:817-891)"""

@@ -67,6 +67,10 @@ class PilosaHTTPServer:
             Route("GET", r"/internal/nodes", self._get_nodes),
             Route("GET", r"/internal/index/(?P<index>[^/]+)/shards",
                   self._get_index_shards),
+            Route("GET",
+                  r"/internal/index/(?P<index>[^/]+)/shard/(?P<shard>[0-9]+)"
+                  r"/fragments",
+                  self._get_shard_fragments),
             Route("POST", r"/internal/cluster/message", self._post_message),
             Route("GET", r"/internal/fragment/blocks",
                   self._get_fragment_blocks),
@@ -78,6 +82,13 @@ class PilosaHTTPServer:
             Route("GET", r"/internal/attr/blocks", self._get_attr_blocks),
             Route("GET", r"/internal/attr/data", self._get_attr_block_data),
             Route("POST", r"/recalculate-caches", self._recalculate_caches),
+            Route("POST", r"/cluster/resize/add-node", self._resize_add_node),
+            Route("POST", r"/cluster/resize/remove-node",
+                  self._resize_remove_node),
+            Route("POST", r"/cluster/resize/abort", self._resize_abort),
+            Route("GET", r"/cluster/resize/status", self._resize_status),
+            Route("POST", r"/cluster/resize/set-coordinator",
+                  self._set_coordinator),
             Route("GET", r"/metrics", self._get_metrics),
         ]
 
@@ -199,6 +210,10 @@ class PilosaHTTPServer:
     def _get_index_shards(self, req):
         return self.api.index_shards(req.params["index"])
 
+    def _get_shard_fragments(self, req):
+        return self.api.shard_fragments(
+            req.params["index"], req.params["shard"])
+
     def _post_message(self, req):
         self.api.receive_message(req.body)
         return None
@@ -240,6 +255,25 @@ class PilosaHTTPServer:
     def _recalculate_caches(self, req):
         self.api.recalculate_caches()
         return None
+
+    # -- resize admin (reference: /cluster/resize/* api.go:1193-1267) ---------
+
+    def _resize_add_node(self, req):
+        return self.api.resize_add_node(req.json() or {})
+
+    def _resize_remove_node(self, req):
+        body = req.json() or {}
+        return self.api.resize_remove_node(body.get("id"))
+
+    def _resize_abort(self, req):
+        return self.api.resize_abort()
+
+    def _resize_status(self, req):
+        return self.api.resize_status()
+
+    def _set_coordinator(self, req):
+        body = req.json() or {}
+        return self.api.set_coordinator(body.get("id"))
 
     def _get_metrics(self, req):
         from ..utils.stats import global_stats

@@ -1,0 +1,237 @@
+"""Cluster resize tests (reference behavior: §3.5 — resize jobs stream
+fragments to new owners; holderCleaner reclaims unowned fragments)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import Cluster, Node, clean_holder
+from pilosa_tpu.server import API, Client
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from .harness import ServerHarness
+
+
+def wait_until(fn, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_node(harness):
+    return Node(id=harness.address.split("//", 1)[1], uri=harness.address)
+
+
+def attach_cluster(harness, node_list, replica_n=1):
+    local_id = harness.address.split("//", 1)[1]
+    cluster = Cluster(
+        nodes=[Node(n.id, n.uri, is_coordinator=n.is_coordinator)
+               for n in node_list],
+        local_id=local_id, replica_n=replica_n, path=harness.data_dir)
+    harness.api = API(harness.holder, cluster=cluster, client_factory=Client)
+    harness.server.api = harness.api
+    harness.cluster = cluster
+
+
+class ResizableCluster:
+    """2 active nodes + 1 standby that will join via resize."""
+
+    def __init__(self, replica_n=1):
+        self.all = [ServerHarness() for _ in range(3)]
+        nodes = [make_node(h) for h in self.all]
+        nodes[0].is_coordinator = True
+        # nodes 0,1 know a 2-node cluster; node 2 bootstraps knowing all 3
+        for h in self.all[:2]:
+            attach_cluster(h, nodes[:2], replica_n)
+        attach_cluster(self.all[2], nodes, replica_n)
+
+    def close(self):
+        for h in self.all:
+            h.close()
+
+
+@pytest.fixture
+def rcluster():
+    c = ResizableCluster()
+    yield c
+    c.close()
+
+
+def _local_fragment_shards(harness, index, field):
+    idx = harness.holder.index(index)
+    if idx is None:
+        return set()
+    f = idx.field(field)
+    view = f.view()
+    return set(view.fragments) if view else set()
+
+
+def test_add_node_streams_fragments(rcluster):
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("ri")
+    a.api.create_field("ri", "f")
+    rng = np.random.default_rng(11)
+    cols = rng.integers(0, 6 * SHARD_WIDTH, 500, dtype=np.uint64)
+    rows = rng.integers(0, 4, 500, dtype=np.uint64)
+    a.api.import_bits("ri", "f", rows, cols)
+    before = a.client.query("ri", "Count(Row(f=1))")["results"][0]
+    assert before > 0
+
+    job = a.client.resize_add_node(make_node(new).id, new.address)
+    assert wait_until(lambda: a.client.resize_status()["job"] is not None
+                      and a.client.resize_status()["job"]["state"] == "DONE")
+
+    # every node agrees on the 3-node topology and NORMAL state
+    for h in c.all:
+        assert len(h.cluster.nodes) == 3
+        assert h.cluster.state == "NORMAL"
+
+    # queries from every node (including the new one) see the same data
+    for h in c.all:
+        assert h.client.query("ri", "Count(Row(f=1))")["results"][0] == before
+
+    # the new node physically holds fragments for the shards it owns
+    owned_by_new = {
+        s for s in range(6)
+        if new.cluster.owns_shard(new.cluster.local_id, "ri", s)}
+    assert owned_by_new, "3-node placement should give the new node shards"
+    have = _local_fragment_shards(new, "ri", "f")
+    assert owned_by_new <= have
+
+    # old nodes dropped fragments they no longer own (holderCleaner)
+    for h in (a, b):
+        have = _local_fragment_shards(h, "ri", "f")
+        for s in have:
+            assert h.cluster.owns_shard(h.cluster.local_id, "ri", s)
+
+
+def test_remove_node_redistributes(rcluster):
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("rr")
+    a.api.create_field("rr", "f")
+    cols = np.arange(0, 4 * SHARD_WIDTH, 997, dtype=np.uint64)
+    a.api.import_bits("rr", "f", np.zeros(len(cols), np.uint64), cols)
+    want = a.client.query("rr", "Count(Row(f=0))")["results"][0]
+
+    # grow to 3 first
+    a.client.resize_add_node(make_node(new).id, new.address)
+    assert wait_until(
+        lambda: a.client.resize_status()["job"]["state"] == "DONE")
+    assert new.client.query("rr", "Count(Row(f=0))")["results"][0] == want
+
+    # now remove node b: its shards move to remaining owners
+    b_id = b.cluster.local_id
+    a.client.resize_remove_node(b_id)
+    assert wait_until(
+        lambda: a.client.resize_status()["job"]["state"] == "DONE")
+    assert len(a.cluster.nodes) == 2
+    assert all(n.id != b_id for n in a.cluster.nodes)
+    for h in (a, new):
+        assert h.client.query("rr", "Count(Row(f=0))")["results"][0] == want
+
+
+def test_queries_blocked_while_resizing(rcluster):
+    from pilosa_tpu.server import ApiError
+
+    c = rcluster
+    a = c.all[0]
+    a.api.create_index("rb")
+    a.api.create_field("rb", "f")
+    a.api.import_bits("rb", "f", [0], [1])
+    a.cluster.state = "RESIZING"
+    try:
+        with pytest.raises(ApiError, match="resizing"):
+            a.api.query("rb", "Count(Row(f=0))")
+    finally:
+        a.cluster.state = "NORMAL"
+
+
+def test_unreachable_node_aborts_cleanly(rcluster):
+    from pilosa_tpu.cluster import ResizeError
+
+    c = rcluster
+    a = c.all[0]
+    a.api.create_index("ra")
+    a.api.create_field("ra", "f")
+    a.api.import_bits("ra", "f", [0], [1])
+
+    # a dead joining node: instruction delivery fails -> clean revert
+    with pytest.raises(ResizeError):
+        a.api.resize.add_node(Node(id="ghost", uri="http://127.0.0.1:1"))
+    assert len(a.cluster.nodes) == 2
+    assert a.cluster.state == "NORMAL"
+    assert a.client.query("ra", "Count(Row(f=0))")["results"][0] == 1
+
+
+def test_abort_restores_topology(rcluster):
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("ra2")
+    a.api.create_field("ra2", "f")
+    a.api.import_bits("ra2", "f", [0], [1])
+
+    # a "ghost" node whose URI is b's server: instructions deliver, but b
+    # reports completion under its own id, so the job never completes ->
+    # stays RUNNING and can be aborted.
+    job = a.api.resize.add_node(Node(id="zzz-ghost", uri=b.address))
+    assert job.state == "RUNNING"
+    assert a.cluster.state == "RESIZING"
+    aborted = a.client.resize_abort()
+    assert aborted["state"] == "ABORTED"
+    assert len(a.cluster.nodes) == 2
+    assert a.cluster.state == "NORMAL"
+    assert a.client.query("ra2", "Count(Row(f=0))")["results"][0] == 1
+
+
+def test_failed_instruction_reverts_topology(rcluster):
+    """A follower reporting an error fails the job and restores the old
+    topology instead of leaving the cluster RESIZING forever."""
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("rf")
+    a.api.create_field("rf", "f")
+    a.api.import_bits("rf", "f", [0], [1])
+    job = a.api.resize.add_node(Node(id="zzz-ghost", uri=b.address))
+    assert job.state == "RUNNING"
+    a.api.resize.mark_complete(job.id, "zzz-ghost", error="stream failed")
+    assert job.state == "FAILED"
+    assert len(a.cluster.nodes) == 2
+    assert a.cluster.state == "NORMAL"
+    assert a.client.query("rf", "Count(Row(f=0))")["results"][0] == 1
+
+
+def test_remove_coordinator_forbidden(rcluster):
+    from pilosa_tpu.cluster import ResizeError
+
+    c = rcluster
+    a = c.all[0]
+    with pytest.raises(ResizeError, match="coordinator"):
+        a.api.resize.remove_node(a.cluster.local_id)
+
+
+def test_clean_holder_unit(tmp_path):
+    from pilosa_tpu.core import Holder
+
+    holder = Holder(str(tmp_path), use_snapshot_queue=False).open()
+    idx = holder.create_index("ch")
+    f = idx.create_field("f")
+    f.set_bit(0, 1)
+    f.set_bit(0, SHARD_WIDTH + 1)
+    # a cluster where this node owns nothing
+    cluster = Cluster(nodes=[Node("other", "http://x")], local_id="me",
+                      replica_n=1)
+    removed = clean_holder(holder, cluster)
+    assert removed >= 2
+    assert not _local_fragment_shards_holder(holder, "ch", "f")
+    holder.close()
+
+
+def _local_fragment_shards_holder(holder, index, field):
+    view = holder.index(index).field(field).view()
+    return set(view.fragments) if view else set()
