@@ -87,17 +87,24 @@ def cmd_server(args):
         monitor = HealthMonitor(cluster, Client).start()
 
     api = API(holder, cluster=cluster)
-    server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
-    server.start()
     anti_entropy = None
+    translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
         from .server import Client as _Client
         from .server.syncer import AntiEntropyMonitor, HolderSyncer
+        from .server.translate_sync import TranslateReplicator
 
         interval = parse_duration(
             config.get("anti-entropy", {}).get("interval", "10m"))
         anti_entropy = AntiEntropyMonitor(
             HolderSyncer(holder, cluster, _Client), interval).start()
+        # BEFORE serving: replica stores must be read-only from the first
+        # request, or a keyed import could allocate ids that diverge from
+        # the primary's
+        translate_repl = TranslateReplicator(
+            holder, cluster, _Client).start()
+    server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
+    server.start()
     extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
           f"(data: {data_dir}{extra})", flush=True)
@@ -107,6 +114,8 @@ def cmd_server(args):
     except KeyboardInterrupt:
         pass
     finally:
+        if translate_repl:
+            translate_repl.stop()
         if anti_entropy:
             anti_entropy.stop()
         if monitor:

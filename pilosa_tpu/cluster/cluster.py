@@ -117,6 +117,11 @@ class Cluster:
 
     def determine_state(self):
         with self._lock:
+            if self.state == "RESIZING":
+                # only the resize manager may leave RESIZING (finalize,
+                # abort, or failure) — health transitions must not unblock
+                # queries mid-stream
+                return self.state
             down = sum(1 for n in self.nodes if n.state == NODE_STATE_DOWN)
             if down == 0:
                 self.state = CLUSTER_STATE_NORMAL

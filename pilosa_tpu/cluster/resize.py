@@ -138,7 +138,7 @@ class ResizeManager:
                 # data moves to it (reference: NodeStatus schema sync on
                 # join gossip/gossip.go LocalState)
                 instructions[node.id] = {
-                    "jobID": None, "node": node.id, "sources": [],
+                    "node": node.id, "sources": [],
                     "schema": self.holder.schema()}
             job = ResizeJob(uuid.uuid4().hex[:12], action, old_nodes,
                             new_nodes, instructions)
@@ -210,12 +210,9 @@ class ResizeManager:
                     by_dest.setdefault(dest_id, []).append({
                         "index": idx.name, "shard": shard,
                         "sourceID": src.id, "sourceURI": src.uri})
-        job_id = None  # filled by caller context; embedded below
-        out = {}
-        for dest_id, srcs in by_dest.items():
-            out[dest_id] = {"jobID": job_id, "node": dest_id,
-                            "sources": srcs, "schema": schema}
-        return out
+        # jobID is stamped by _send_instruction once the job exists
+        return {dest_id: {"node": dest_id, "sources": srcs, "schema": schema}
+                for dest_id, srcs in by_dest.items()}
 
     def _send_instruction(self, node_id, instr, new_nodes):
         instr = dict(instr)
@@ -278,10 +275,10 @@ class ResizeManager:
         payload = {"state": state, "nodes": [n.to_json() for n in nodes]}
         by_id = {n.id: n for n in targets}
         by_id.pop(self.cluster.local_id, None)
-        data = Serializer.marshal(MessageType.CLUSTER_STATUS, payload)
         for node in by_id.values():
             try:
-                self.client_factory(node.uri).send_message(data)
+                self.broadcaster.send_to(
+                    node, MessageType.CLUSTER_STATUS, payload)
             except Exception:
                 logger.warning("cluster-status to %s failed", node.id)
 

@@ -112,7 +112,8 @@ class FieldOptions:
 
 class Field:
     def __init__(self, path, index_name, name, options=None,
-                 max_op_n=None, snapshot_queue=None, row_attr_store=None):
+                 max_op_n=None, snapshot_queue=None, row_attr_store=None,
+                 translate_configurer=None):
         self.path = path
         self.index_name = index_name
         self.name = name
@@ -122,6 +123,7 @@ class Field:
         self.views = {}  # name -> View
         self.row_attr_store = row_attr_store
         self.translate_store = None  # row key translation when keys=True
+        self.translate_configurer = translate_configurer
         self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -146,6 +148,8 @@ class Field:
             self.translate_store = SqliteTranslateStore(
                 os.path.join(self.path, ".keys.db"),
                 index=self.index_name, field=self.name)
+            if self.translate_configurer is not None:
+                self.translate_configurer(self.translate_store)
         views_dir = os.path.join(self.path, "views")
         if os.path.isdir(views_dir):
             for name in sorted(os.listdir(views_dir)):

@@ -71,6 +71,9 @@ class Holder:
         self.path = path
         self.max_op_n = max_op_n
         self.indexes = {}
+        # set by the TranslateReplicator before indexes open so replica
+        # stores come up read-only with the primary-forward hook installed
+        self.translate_configurer = None
         self.snapshot_queue = SnapshotQueue() if use_snapshot_queue else None
         # periodic TopN cache persistence (reference: holder.go:506-549);
         # <=0 disables the ticker (fragments still flush on close)
@@ -151,9 +154,19 @@ class Holder:
     def _new_index(self, name):
         idx = Index(
             os.path.join(self.path, name), name, max_op_n=self.max_op_n,
-            snapshot_queue=self.snapshot_queue)
+            snapshot_queue=self.snapshot_queue,
+            translate_configurer=self.translate_configurer)
         self.indexes[name] = idx
         return idx
+
+    def translate_stores(self):
+        """Every live translate store (index column + field row stores)."""
+        for idx in list(self.indexes.values()):
+            if idx.translate_store is not None:
+                yield idx.translate_store
+            for field in list(idx.fields.values()):
+                if field.translate_store is not None:
+                    yield field.translate_store
 
     def index(self, name):
         return self.indexes.get(name)

@@ -44,7 +44,7 @@ class IndexOptions:
 class Index:
     def __init__(self, path, name, options=None, max_op_n=None,
                  snapshot_queue=None, column_attr_store=None,
-                 row_attr_stores=None):
+                 row_attr_stores=None, translate_configurer=None):
         self.path = path
         self.name = name
         self.options = options or IndexOptions()
@@ -53,6 +53,9 @@ class Index:
         self.fields = {}
         self.column_attr_store = column_attr_store
         self.translate_store = None  # column key translation when keys=True
+        # called with each new translate store (replication wiring: sets
+        # read-only + the remote-create hook before any write can race)
+        self.translate_configurer = translate_configurer
         self._row_attr_stores = row_attr_stores or {}
         self._lock = threading.RLock()
 
@@ -79,6 +82,8 @@ class Index:
         if self.options.keys and self.translate_store is None:
             self.translate_store = SqliteTranslateStore(
                 os.path.join(self.path, ".keys.db"), index=self.name)
+            if self.translate_configurer is not None:
+                self.translate_configurer(self.translate_store)
         for name in sorted(os.listdir(self.path)):
             sub = os.path.join(self.path, name)
             if os.path.isdir(sub) and os.path.exists(os.path.join(sub, ".meta")):
@@ -110,7 +115,8 @@ class Index:
         field = Field(
             os.path.join(self.path, name), self.name, name, options=options,
             max_op_n=self.max_op_n, snapshot_queue=self.snapshot_queue,
-            row_attr_store=self._row_attr_stores.get(name))
+            row_attr_store=self._row_attr_stores.get(name),
+            translate_configurer=self.translate_configurer)
         self.fields[name] = field
         return field
 

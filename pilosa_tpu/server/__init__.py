@@ -5,3 +5,4 @@ from .api import API, ApiError, ConflictError, NotFoundError
 from .client import Client, ClientError
 from .http_server import PilosaHTTPServer
 from .syncer import AntiEntropyMonitor, FragmentSyncer, HolderSyncer
+from .translate_sync import TranslateReplicator

@@ -542,6 +542,26 @@ class API:
             return {"entries": []}
         return {"entries": [e.to_json() for e in store.entries(int(offset))]}
 
+    def translate_keys_create(self, index_name, field_name, keys):
+        """Allocate ids for keys — served by the chain head; a replica
+        receiving this forwards through its own remote_create hook
+        (reference: translate key writes route to the primary,
+        http/handler.go:518-522)."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        if field_name:
+            field = idx.field(field_name)
+            if field is None:
+                raise NotFoundError(f"field not found: {field_name}")
+            store = field.translate_store
+        else:
+            store = idx.translate_store
+        if store is None:
+            raise ApiError(
+                f"keys not enabled: {index_name}/{field_name or '<index>'}")
+        return {"ids": store.translate_keys(list(keys), create=True)}
+
     def _attr_store(self, index_name, field_name=""):
         idx = self.holder.index(index_name)
         if idx is None:

@@ -197,6 +197,14 @@ class Client:
             "POST", "/cluster/resize/set-coordinator",
             json.dumps({"id": node_id}).encode())
 
+    def translate_keys_create(self, index, field, keys):
+        """Allocate key ids on the primary (reference: translate key
+        writes route to primary http/handler.go:518-522)."""
+        return self._request(
+            "POST", "/internal/translate/keys",
+            json.dumps({"index": index, "field": field,
+                        "keys": list(keys)}).encode())
+
     def attr_blocks(self, index, field=""):
         """(reference: attr diff endpoints api.go:817-891)"""
         return self._request(

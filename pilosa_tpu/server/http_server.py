@@ -79,6 +79,8 @@ class PilosaHTTPServer:
             Route("GET", r"/internal/fragment/data", self._get_fragment_data),
             Route("GET", r"/internal/translate/data",
                   self._get_translate_data),
+            Route("POST", r"/internal/translate/keys",
+                  self._post_translate_keys),
             Route("GET", r"/internal/attr/blocks", self._get_attr_blocks),
             Route("GET", r"/internal/attr/data", self._get_attr_block_data),
             Route("POST", r"/recalculate-caches", self._recalculate_caches),
@@ -242,6 +244,12 @@ class PilosaHTTPServer:
         return self.api.translate_data(
             self._q1(req, "index"), self._q1(req, "field", ""),
             int(self._q1(req, "offset", "0")))
+
+    def _post_translate_keys(self, req):
+        body = req.json() or {}
+        return self.api.translate_keys_create(
+            body.get("index", ""), body.get("field", ""),
+            body.get("keys", []))
 
     def _get_attr_blocks(self, req):
         return self.api.attr_blocks(

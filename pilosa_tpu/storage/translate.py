@@ -61,6 +61,11 @@ class TranslateStore:
         self.index = index
         self.field = field
         self._read_only = False
+        # Replica-side hook: called with the missing keys when a create
+        # hits a read-only store; must return their ids (allocated on the
+        # primary). Installed by the TranslateReplicator (reference:
+        # ErrTranslateStoreReadOnly redirect http/handler.go:518-522).
+        self.remote_create = None
 
     # -- read-only flag ------------------------------------------------------
 
@@ -83,6 +88,19 @@ class TranslateStore:
         return self.translate_keys([key], create=create)[0]
 
     def translate_keys(self, keys, create=True):
+        try:
+            return self._translate_keys(keys, create=create)
+        except TranslateReadOnlyError:
+            if self.remote_create is None:
+                raise
+            # allocate on the primary, then mirror locally so subsequent
+            # lookups resolve before the replication poll catches up
+            ids = self.remote_create(list(keys))
+            for key, id in zip(keys, ids):
+                self.force_set(id, key)
+            return ids
+
+    def _translate_keys(self, keys, create=True):
         raise NotImplementedError
 
     def translate_id(self, id):
@@ -127,7 +145,7 @@ class SqliteTranslateStore(TranslateStore):
             row = self._db.execute("SELECT MAX(id) FROM keys").fetchone()
         return int(row[0] or 0)
 
-    def translate_keys(self, keys, create=True):
+    def _translate_keys(self, keys, create=True):
         for key in keys:
             if not isinstance(key, str):
                 raise TypeError(f"translate key must be str: {key!r}")
@@ -207,7 +225,7 @@ class MemTranslateStore(TranslateStore):
     def max_id(self):
         return self._max
 
-    def translate_keys(self, keys, create=True):
+    def _translate_keys(self, keys, create=True):
         out = []
         with self._lock:
             for key in keys:

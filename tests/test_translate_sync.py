@@ -1,0 +1,141 @@
+"""Translate-store chain replication tests (reference behavior:
+holder.go:702-880 replicator, cluster.go:2019 PrimaryReplicaNode)."""
+
+import pytest
+
+from pilosa_tpu.server import Client, TranslateReplicator
+from pilosa_tpu.storage import TranslateReadOnlyError
+
+from .harness import ClusterHarness
+
+
+@pytest.fixture()
+def cluster3():
+    c = ClusterHarness(3, replica_n=2)
+    # attach a replicator per node (not started: tests pump manually)
+    reps = []
+    for h in c.nodes:
+        reps.append(TranslateReplicator(h.holder, h.cluster, Client))
+        h.replicator = reps[-1]
+    yield c
+    c.close()
+
+
+def chain_order(c):
+    """Harness nodes in cluster (sorted-id) order: [head, mid, tail]."""
+    return [c.node_by_id(n.id) for n in c[0].cluster.nodes]
+
+
+def pump(c):
+    """One replication pass on every node, chain order (head first)."""
+    for h in chain_order(c):
+        h.replicator.replicate_once()
+
+
+def test_replica_stores_are_read_only(cluster3):
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t", options=_keyed_index())
+    idx_head = head.holder.index("t")
+    assert not idx_head.translate_store.read_only
+    for h in (mid, tail):
+        store = h.holder.index("t").translate_store
+        assert store.read_only
+        # direct create without the hook raises
+        store.remote_create = None
+        with pytest.raises(TranslateReadOnlyError):
+            store.translate_key("nope")
+        h.replicator.configure_store(store)  # restore hook
+
+
+def _keyed_index():
+    from pilosa_tpu.core import IndexOptions
+
+    return IndexOptions(keys=True)
+
+
+def test_chain_replication_propagates_keys(cluster3):
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t2", options=_keyed_index())
+    store = head.holder.index("t2").translate_store
+    ids = store.translate_keys(["alpha", "beta", "gamma"])
+    pump(c)  # head->mid, then mid->tail
+    for h in (mid, tail):
+        s = h.holder.index("t2").translate_store
+        assert s.translate_ids(ids) == ["alpha", "beta", "gamma"]
+
+
+def test_replica_create_forwards_to_primary(cluster3):
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t3", options=_keyed_index())
+    # create via the TAIL: forwards to head, mirrors locally
+    tail_store = tail.holder.index("t3").translate_store
+    ids = tail_store.translate_keys(["via-tail"])
+    assert ids == [1]
+    # primary has it
+    assert head.holder.index("t3").translate_store.translate_ids(ids) == \
+        ["via-tail"]
+    # tail resolved locally without waiting for replication
+    assert tail_store.translate_ids(ids) == ["via-tail"]
+    # mid catches up by replication
+    pump(c)
+    assert mid.holder.index("t3").translate_store.translate_ids(ids) == \
+        ["via-tail"]
+
+
+def test_keyed_query_via_replica_consistent_ids(cluster3):
+    """End-to-end: Set() with keys via a replica allocates on the primary,
+    so every node agrees key<->id after replication."""
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t4", options=_keyed_index())
+    head.api.create_field("t4", "f")
+    # write through the tail node's API (keyed column)
+    tail.api.query("t4", 'Set("colA", f=3)')
+    mid.api.query("t4", 'Set("colB", f=3)')
+    pump(c)
+    # all nodes translate identically
+    stores = [h.holder.index("t4").translate_store for h in (head, mid, tail)]
+    ids_a = {s.translate_key("colA", create=False) for s in stores}
+    ids_b = {s.translate_key("colB", create=False) for s in stores}
+    assert len(ids_a) == 1 and None not in ids_a
+    assert len(ids_b) == 1 and None not in ids_b
+    assert ids_a != ids_b
+    # the keyed row read agrees from any node
+    for h in (head, mid, tail):
+        res = h.api.query("t4", "Row(f=3)")
+        assert sorted(res[0].keys) == ["colA", "colB"]
+
+
+def test_field_key_replication(cluster3):
+    from pilosa_tpu.core import FieldOptions
+
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t5")
+    head.api.create_field("t5", "kf", options=FieldOptions(keys=True))
+    tail.api.query("t5", 'Set(7, kf="rowkey")')
+    pump(c)
+    for h in (head, mid, tail):
+        s = h.holder.index("t5").field("kf").translate_store
+        assert s.translate_key("rowkey", create=False) is not None
+    res = head.api.query("t5", 'Row(kf="rowkey")')
+    assert list(res[0].columns()) == [7]
+
+
+def test_refresh_after_topology_change(cluster3):
+    """When the head is removed from the topology, the next node becomes
+    writable after refresh()."""
+    c = cluster3
+    head, mid, tail = chain_order(c)
+    head.api.create_index("t6", options=_keyed_index())
+    mid_store = mid.holder.index("t6").translate_store
+    assert mid_store.read_only
+    # drop the head from mid's view of the cluster
+    mid.cluster.nodes = [n for n in mid.cluster.nodes
+                         if n.id != head.cluster.local_id]
+    mid.replicator.refresh()
+    assert not mid_store.read_only
+    assert mid_store.translate_key("promoted") is not None
