@@ -49,6 +49,17 @@ def apply_op_chain(acc, planes, ops):
     return acc
 
 
+def _is_multi_device(x):
+    """True when `x` is a jax array spanning more than one device."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except AttributeError:
+        return False
+
+
 _count_expr_cache = {}
 
 
@@ -81,13 +92,25 @@ class QueryKernels:
     @staticmethod
     def count_intersect(a, b):
         """Σ_shards popcount(a & b) — the north-star query."""
-        return _count_expr_fn("&", 2)(a, b)
+        return QueryKernels.count_expr([a, b], "&")
 
     @staticmethod
     def count_expr(planes, ops):
         """Evaluate a fused op chain over aligned stacks then popcount.
         `planes`: list of [S, W] stacks; `ops`: string like "&|^" applied
-        left-to-right."""
+        left-to-right. Dispatches to the Pallas backend when opted in
+        (PILOSA_TPU_PALLAS=1) AND the inputs live on at most one device —
+        pallas_call under plain jit can't be GSPMD-partitioned, so
+        mesh-sharded stacks always take the jnp path (which XLA partitions
+        over whatever sharding the inputs carry). The jnp path is also the
+        default on a single device — measured at parity on TPU (see
+        ops/pallas_kernels.py)."""
+        from ..ops import pallas_kernels
+
+        if pallas_kernels.enabled() and not any(
+                _is_multi_device(p) for p in planes):
+            return pallas_kernels.count_expr_stack(
+                planes[0], planes[1:], tuple(ops))
         return _count_expr_fn(ops, len(planes))(*planes)
 
 
