@@ -1,0 +1,157 @@
+// Native host-side kernels for pilosa_tpu.
+//
+// The reference implements its performance-critical bit manipulation as
+// hand-optimized Go (roaring/roaring.go:3121-5196 container kernels,
+// roaring.go:5291 popcount slices). In this framework the *query-time*
+// algebra runs on TPU (ops/bitplane.py, ops/pallas_kernels.py); what stays
+// on the host is the storage/interchange path — roaring container
+// encode/decode, WAL op checksums, and position<->plane conversion on
+// import/export (reference: fragment.bulkImport fragment.go:1997,
+// ImportRoaringBits roaring.go:1511, op checksums roaring.go:4694). Those
+// loops are here, exposed C-ABI for ctypes (no pybind11 in this image).
+//
+// Build: `make -C native` -> native/libpilosa_native.so. Pure-Python
+// fallbacks exist for every function (pilosa_tpu/native.py).
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// FNV-1a 32-bit over a buffer, chainable via h0 (initial basis 2166136261).
+// Reference: op checksum roaring.go:4694-4793.
+uint32_t pilosa_fnv1a32(const uint8_t* data, size_t len, uint32_t h0) {
+    uint32_t h = h0;
+    for (size_t i = 0; i < len; i++) {
+        h ^= data[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+// Total popcount of a uint32 buffer. Plain word loop — numpy only
+// guarantees 4-byte alignment, and -O3 vectorizes this anyway.
+int64_t pilosa_popcount(const uint32_t* words, size_t n) {
+    int64_t total = 0;
+    for (size_t i = 0; i < n; i++) total += __builtin_popcount(words[i]);
+    return total;
+}
+
+// Per-word popcount (int64 out, matching containers.popcount32).
+void pilosa_popcount_per_word(const uint32_t* words, size_t n, int64_t* out) {
+    for (size_t i = 0; i < n; i++) out[i] = __builtin_popcount(words[i]);
+}
+
+// Scatter bit positions into a little-endian uint32 plane. Positions out of
+// range are ignored (returns number applied). Used by import paths
+// (plane_from_columns) and array-container expansion (values_to_words).
+size_t pilosa_scatter_u64(const uint64_t* pos, size_t n, uint32_t* plane,
+                          size_t plane_words) {
+    const uint64_t nbits = (uint64_t)plane_words * 32;
+    size_t applied = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t p = pos[i];
+        if (p >= nbits) continue;
+        plane[p >> 5] |= (uint32_t)1 << (p & 31);
+        applied++;
+    }
+    return applied;
+}
+
+size_t pilosa_scatter_u16(const uint16_t* pos, size_t n, uint32_t* plane,
+                          size_t plane_words) {
+    const uint32_t nbits = (uint32_t)plane_words * 32;
+    size_t applied = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint32_t p = pos[i];
+        if (p >= nbits) continue;
+        plane[p >> 5] |= (uint32_t)1 << (p & 31);
+        applied++;
+    }
+    return applied;
+}
+
+// Extract sorted set-bit positions from a plane. `out` must hold at least
+// pilosa_popcount(plane) entries. Returns count written.
+size_t pilosa_extract_u64(const uint32_t* plane, size_t plane_words,
+                          uint64_t* out) {
+    size_t k = 0;
+    for (size_t w = 0; w < plane_words; w++) {
+        uint32_t v = plane[w];
+        uint64_t base = (uint64_t)w * 32;
+        while (v) {
+            out[k++] = base + __builtin_ctz(v);
+            v &= v - 1;
+        }
+    }
+    return k;
+}
+
+size_t pilosa_extract_u16(const uint32_t* plane, size_t plane_words,
+                          uint16_t* out) {
+    size_t k = 0;
+    for (size_t w = 0; w < plane_words; w++) {
+        uint32_t v = plane[w];
+        uint32_t base = (uint32_t)w * 32;
+        while (v) {
+            out[k++] = (uint16_t)(base + __builtin_ctz(v));
+            v &= v - 1;
+        }
+    }
+    return k;
+}
+
+// Detect [start, last] inclusive runs of set bits in a <=2^16-bit container
+// plane (reference: Container.optimize run counting roaring.go:2334).
+// `out_pairs` must hold 2 * (plane_words*16 + 1) uint16 in the worst case
+// (alternating bits). Returns run count.
+size_t pilosa_extract_runs_u16(const uint32_t* plane, size_t plane_words,
+                               uint16_t* out_pairs) {
+    size_t nruns = 0;
+    bool in_run = false;
+    uint32_t start = 0;
+    for (size_t w = 0; w < plane_words; w++) {
+        uint32_t v = plane[w];
+        if (!in_run && v == 0) continue;
+        if (in_run && v == 0xFFFFFFFFu) continue;
+        uint32_t base = (uint32_t)w * 32;
+        for (uint32_t b = 0; b < 32; b++) {
+            bool bit = (v >> b) & 1;
+            if (bit && !in_run) {
+                start = base + b;
+                in_run = true;
+            } else if (!bit && in_run) {
+                out_pairs[2 * nruns] = (uint16_t)start;
+                out_pairs[2 * nruns + 1] = (uint16_t)(base + b - 1);
+                nruns++;
+                in_run = false;
+            }
+        }
+    }
+    if (in_run) {
+        out_pairs[2 * nruns] = (uint16_t)start;
+        out_pairs[2 * nruns + 1] = (uint16_t)(plane_words * 32 - 1);
+        nruns++;
+    }
+    return nruns;
+}
+
+// Fill [start, last] (inclusive) bit range in a plane.
+void pilosa_fill_range(uint32_t* plane, size_t plane_words, uint32_t start,
+                       uint32_t last) {
+    uint64_t nbits = (uint64_t)plane_words * 32;
+    if (start >= nbits) return;
+    if (last >= nbits) last = (uint32_t)(nbits - 1);
+    uint32_t sw = start >> 5, lw = last >> 5;
+    uint32_t smask = 0xFFFFFFFFu << (start & 31);
+    uint32_t lmask = 0xFFFFFFFFu >> (31 - (last & 31));
+    if (sw == lw) {
+        plane[sw] |= smask & lmask;
+        return;
+    }
+    plane[sw] |= smask;
+    for (uint32_t w = sw + 1; w < lw; w++) plane[w] = 0xFFFFFFFFu;
+    plane[lw] |= lmask;
+}
+
+}  // extern "C"

@@ -134,36 +134,44 @@ def shift(a, n=1):
 
 def plane_from_columns(cols):
     """Host helper: build a [WORDS_PER_ROW] uint32 plane from shard-relative
-    column offsets (numpy, used by import paths and tests)."""
-    plane = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+    column offsets (native scatter, used by import paths and tests). Offsets
+    must already be shard-relative — a value >= SHARD_WIDTH means the caller
+    forgot to subtract the shard base, so fail loudly rather than let the
+    scatter primitive silently drop it."""
+    from .. import native
+
     cols = np.asarray(cols, dtype=np.uint64)
-    words = (cols // WORD_BITS).astype(np.int64)
-    bits = (cols % np.uint64(WORD_BITS)).astype(np.uint32)
-    np.bitwise_or.at(plane, words, np.uint32(1) << bits)
+    if cols.size and int(cols.max()) >= SHARD_WIDTH:
+        raise ValueError(
+            f"column offset {int(cols.max())} >= shard width {SHARD_WIDTH}")
+    plane = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+    native.scatter(cols, plane)
     return plane
 
 
 def columns_from_plane(plane):
     """Host helper: shard-relative column offsets of set bits, sorted."""
-    plane = np.asarray(plane, dtype=np.uint32)
-    words = np.nonzero(plane)[0]
-    out = []
-    for w in words:
-        v = int(plane[w])
-        base = w * WORD_BITS
-        while v:
-            b = v & -v
-            out.append(base + b.bit_length() - 1)
-            v ^= b
-    return np.array(out, dtype=np.uint64)
+    from .. import native
+
+    return native.extract(np.asarray(plane, dtype=np.uint32))
 
 
 @partial(jax.jit, static_argnames=("k",))
+def _topn_counts_jnp(stack, filter_plane, k):
+    counts = popcount_rows(stack & filter_plane[None, :])
+    vals, idx = jax.lax.top_k(counts, k)
+    return vals, idx
+
+
 def topn_counts(stack, filter_plane, k):
     """Per-row intersection counts then top-k (reference: fragment.top
     fragment.go:1570 + cache heap merge). Returns (counts [k], slots [k]).
     top_k returns real slot indices even for zero counts — callers MUST drop
-    entries with count == 0 (the reference's top excludes empty rows)."""
-    counts = popcount_rows(stack & filter_plane[None, :])
-    vals, idx = jax.lax.top_k(counts, k)
-    return vals, idx
+    entries with count == 0 (the reference's top excludes empty rows).
+    Dispatches to the Pallas backend under the same opt-in gate as
+    QueryKernels.count_expr."""
+    from . import pallas_kernels
+
+    if pallas_kernels.enabled():
+        return pallas_kernels.topn_counts_stack(stack, filter_plane, k)
+    return _topn_counts_jnp(stack, filter_plane, k)

@@ -59,9 +59,11 @@ def available():
 
 
 def enabled():
-    """Use pallas for the serving hot path? Opt-in: XLA's fused jnp path is
-    at parity on TPU (see module docstring), so default off."""
-    return os.environ.get("PILOSA_TPU_PALLAS", "0") == "1" and available()
+    """Use pallas for the serving hot path? Opt-in AND real TPU only: XLA's
+    fused jnp path is at parity on TPU (see module docstring) and on other
+    backends the kernels would run through the (very slow) interpreter."""
+    return (os.environ.get("PILOSA_TPU_PALLAS", "0") == "1"
+            and jax.default_backend() == "tpu" and available())
 
 
 def _pad_rows(x, block):
@@ -145,6 +147,8 @@ def count_expr_stack(first, rest, ops):
     if len(ops) != len(rest):
         raise ValueError(
             f"op chain length {len(ops)} != operand count {len(rest)}")
+    if first.shape[0] == 0:
+        return jnp.int32(0)  # empty grid would never write the output
     planes = [_pad_rows(jnp.asarray(p), _BLOCK_ROWS)
               for p in (first, *rest)]
     run = _count_expr_call(ops, planes[0].shape[0], _interpret())
@@ -204,6 +208,8 @@ def topn_counts_stack(rows, filter_plane, k):
     fragment.go:1570. rows: [R, W]; filter_plane: [W]. Returns (vals, idx),
     both [k]; callers drop zero-count entries (as bitplane.topn_counts)."""
     n = rows.shape[0]
+    if n == 0:
+        return jnp.zeros(k, jnp.int32), jnp.zeros(k, jnp.int32)
     rows = _pad_rows(jnp.asarray(rows), _BLOCK_ROWS)
     run = _topn_call(rows.shape[0], _interpret())
     counts = run(rows, jnp.asarray(filter_plane)[None, :])[:n]

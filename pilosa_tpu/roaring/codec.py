@@ -58,11 +58,11 @@ class FormatError(Exception):
 
 
 def fnv1a32(*chunks):
+    from .. import native
+
     h = 2166136261
     for chunk in chunks:
-        for b in chunk:
-            h ^= b
-            h = (h * 16777619) & 0xFFFFFFFF
+        h = native.fnv1a32(chunk, h)
     return h
 
 

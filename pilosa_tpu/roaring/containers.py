@@ -204,8 +204,12 @@ class Container:
 
     def to_runs(self):
         """[R,2] uint16 [start,last] inclusive intervals."""
+        from .. import native
+
         if self.typ == TYPE_RUN:
             return self.runs
+        if self.typ == TYPE_BITMAP:
+            return native.extract_runs(self.words)
         values = self.to_values().astype(np.int64)
         if len(values) == 0:
             return np.empty((0, 2), dtype=np.uint16)
@@ -254,38 +258,30 @@ class Container:
 
 
 def _fill_run(words, start, last):
-    sw, lw = start >> 5, last >> 5
-    if sw == lw:
-        mask = ((np.uint64(1) << np.uint64(last - start + 1)) - np.uint64(1)) << np.uint64(start & 31)
-        words[sw] |= np.uint32(mask & np.uint64(0xFFFFFFFF))
-        return
-    words[sw] |= np.uint32((0xFFFFFFFF << (start & 31)) & 0xFFFFFFFF)
-    words[sw + 1:lw] = np.uint32(0xFFFFFFFF)
-    words[lw] |= np.uint32(0xFFFFFFFF >> (31 - (last & 31)))
+    from .. import native
 
-
-_POP8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint16)
+    native.fill_range(words, start, last)
 
 
 def popcount32(words):
-    b = words.view(np.uint8) if words.dtype == np.uint32 else words.astype(np.uint32).view(np.uint8)
-    return _POP8[b].reshape(-1, 4).sum(axis=1, dtype=np.int64)
+    from .. import native
+
+    if words.dtype != np.uint32:
+        words = words.astype(np.uint32)
+    return native.popcount_per_word(words)
 
 
 def values_to_words(values):
+    from .. import native
+
     words = np.zeros(WORDS, dtype=np.uint32)
     if len(values):
-        v = np.asarray(values, dtype=np.uint32)
-        np.bitwise_or.at(words, v >> 5, np.uint32(1) << (v & np.uint32(31)))
+        native.scatter_u16(np.asarray(values, dtype=np.uint16), words)
     return words
 
 
 def words_to_values(words):
-    """Dense words -> sorted uint16 values, vectorized."""
-    nz = np.nonzero(words)[0]
-    if len(nz) == 0:
-        return np.empty(0, dtype=np.uint16)
-    bits = np.unpackbits(
-        words[nz].view(np.uint8).reshape(-1, 4), axis=1, bitorder="little")
-    w, b = np.nonzero(bits)
-    return (nz[w].astype(np.uint32) * 32 + b).astype(np.uint16)
+    """Dense words -> sorted uint16 values."""
+    from .. import native
+
+    return native.extract_u16(words)

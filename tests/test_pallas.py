@@ -70,24 +70,52 @@ def test_enabled_respects_env(monkeypatch):
     assert pk.enabled() is False
 
 
+def _force_enabled(monkeypatch):
+    """Simulate the serving gate being on (on CPU the real gate also
+    requires backend == 'tpu', so force it for dispatch-wiring tests)."""
+    monkeypatch.setattr(pk, "enabled", lambda: True)
+
+
 def test_query_kernels_dispatch_enabled(rng, monkeypatch):
-    """The QueryKernels hot path with the pallas flag ON must agree with
+    """The QueryKernels hot path with the pallas gate ON must agree with
     the default jnp path (covers the dispatch wiring, not just the
     kernels)."""
     from pilosa_tpu.parallel.sharded import QueryKernels
 
     planes = [_stack(rng, 6) for _ in range(3)]
-    monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
     want = int(QueryKernels.count_expr(planes, "&-"))
-    monkeypatch.setenv("PILOSA_TPU_PALLAS", "1")
-    assert pk.enabled() is True
+    _force_enabled(monkeypatch)
     assert int(QueryKernels.count_expr(planes, "&-")) == want
+
+
+def test_topn_dispatch_enabled(rng, monkeypatch):
+    rows, filt = _stack(rng, 9), _stack(rng, 1)[0]
+    want_v, want_i = bp.topn_counts(rows, filt, 3)
+    _force_enabled(monkeypatch)
+    got_v, got_i = bp.topn_counts(rows, filt, 3)
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_enabled_requires_tpu_backend(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_PALLAS", "1")
+    if jax.default_backend() != "tpu":
+        assert pk.enabled() is False
+
+
+def test_empty_stack_matches_jnp():
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW as W
+
+    empty = np.zeros((0, W), dtype=np.uint32)
+    assert int(pk.count_expr_stack(empty, [empty], ("&",))) == 0
+    v, i = pk.topn_counts_stack(empty, np.zeros(W, np.uint32), 3)
+    assert list(np.asarray(v)) == [0, 0, 0]
 
 
 def test_query_kernels_dispatch_rejects_bad_op(rng, monkeypatch):
     from pilosa_tpu.parallel.sharded import QueryKernels
 
-    monkeypatch.setenv("PILOSA_TPU_PALLAS", "1")
+    _force_enabled(monkeypatch)
     planes = [_stack(rng, 2) for _ in range(2)]
     with pytest.raises(ValueError, match="unknown op"):
         QueryKernels.count_expr(planes, "+")
@@ -106,6 +134,6 @@ def test_query_kernels_dispatch_sharded_inputs(rng, monkeypatch):
     a, b = _stack(rng, s), _stack(rng, s)
     da, db = engine.place(a), engine.place(b)
     assert _is_multi_device(da)
-    monkeypatch.setenv("PILOSA_TPU_PALLAS", "1")
+    _force_enabled(monkeypatch)
     want = int(np.sum(np.asarray(jax.lax.population_count(a & b))))
     assert int(QueryKernels.count_expr([da, db], "&")) == want
