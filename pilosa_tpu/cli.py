@@ -86,7 +86,12 @@ def cmd_server(args):
         cluster.save_topology()
         monitor = HealthMonitor(cluster, Client).start()
 
-    api = API(holder, cluster=cluster)
+    # Slow-query threshold (reference: long-query-time server/config.go);
+    # flag wins over config file; unset disables the log.
+    lqt = getattr(args, "long_query_time", None) \
+        or config.get("long-query-time")
+    api = API(holder, cluster=cluster,
+              long_query_time=parse_duration(lqt) if lqt else None)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -286,6 +291,9 @@ def main(argv=None):
     p.add_argument("--bind", default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config", default=None)
+    p.add_argument("--long-query-time", default=None,
+                   help="log queries slower than this duration "
+                        "(e.g. 500ms, 2s); disabled when unset")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")

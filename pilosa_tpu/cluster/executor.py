@@ -293,9 +293,20 @@ class ClusterExecutor:
                     with lock:
                         errors.append((node.id, e))
 
+        # Fan-out workers must carry the request's trace context (the span
+        # is thread-local; reference: client-side inject http/client.go).
+        from ..utils import tracing
+
+        parent_span = tracing.current_span()
+
+        def run_node_traced(node, node_shards):
+            with tracing.with_span(parent_span):
+                run_node(node, node_shards)
+
         threads = []
         for node, node_shards in by_node.items():
-            t = threading.Thread(target=run_node, args=(node, node_shards))
+            t = threading.Thread(
+                target=run_node_traced, args=(node, node_shards))
             t.start()
             threads.append(t)
         for t in threads:

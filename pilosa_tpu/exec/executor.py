@@ -80,9 +80,16 @@ class Executor:
 
             translate_calls(idx, query.calls)
 
+        from ..utils import tracing
+
         results = []
-        for call in query.calls:
-            results.append(self.execute_call(idx, call, shards, opt))
+        with tracing.start_span(
+                "executor.Execute", index=index_name) as span:
+            for call in query.calls:
+                with tracing.start_span(f"executor.execute{call.name}"):
+                    results.append(self.execute_call(idx, call, shards, opt))
+            if span is not None:
+                span.set_tag("calls", len(query.calls))
 
         if not opt.remote:
             results = translate_results(idx, query.calls, results)

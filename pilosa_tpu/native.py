@@ -39,14 +39,23 @@ def _load():
         lib = None
         if os.environ.get("PILOSA_TPU_NATIVE", "1") != "0":
             try:
-                # Always run make: it no-ops when the .so is current and
-                # rebuilds when the (gitignored) binary is stale.
+                # Build to a process-private name then atomically publish:
+                # concurrent processes (multi-node-on-one-host, xdist) must
+                # never CDLL a half-written .so. make no-ops when current.
+                tmp = f"{_SO_PATH}.{os.getpid()}"
                 subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
+                    ["make", "-C", _NATIVE_DIR, f"SO_OUT={tmp}"],
                     check=True, capture_output=True, timeout=120)
+                if os.path.exists(tmp):
+                    os.replace(tmp, _SO_PATH)
                 lib = ctypes.CDLL(_SO_PATH)
                 _declare(lib)
-            except Exception:
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"pilosa_tpu native library unavailable, using Python "
+                    f"fallbacks ({type(e).__name__}: {e})", RuntimeWarning)
                 lib = None
         _lib = lib
         _tried = True
@@ -243,6 +252,7 @@ def fill_range(plane, start, last):
                               int(start), int(last))
         return plane
     nbits = plane.size * 32
+    start = int(start)  # numpy scalars overflow under NEP-50 shifts below
     if start >= nbits:
         return plane
     last = min(int(last), nbits - 1)

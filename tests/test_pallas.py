@@ -103,12 +103,20 @@ def test_enabled_requires_tpu_backend(monkeypatch):
         assert pk.enabled() is False
 
 
-def test_empty_stack_matches_jnp():
+def test_empty_stack_both_backends(monkeypatch):
+    """Empty stacks: count is 0 and topn is all-zero on BOTH backends (the
+    dispatcher guards before either backend sees the degenerate shape)."""
     from pilosa_tpu.shardwidth import WORDS_PER_ROW as W
 
     empty = np.zeros((0, W), dtype=np.uint32)
+    filt = np.zeros(W, np.uint32)
     assert int(pk.count_expr_stack(empty, [empty], ("&",))) == 0
-    v, i = pk.topn_counts_stack(empty, np.zeros(W, np.uint32), 3)
+    v, i = pk.topn_counts_stack(empty, filt, 3)
+    assert list(np.asarray(v)) == [0, 0, 0]
+    v, i = bp.topn_counts(empty, filt, 3)  # jnp gate
+    assert list(np.asarray(v)) == [0, 0, 0]
+    _force_enabled(monkeypatch)
+    v, i = bp.topn_counts(empty, filt, 3)  # pallas gate
     assert list(np.asarray(v)) == [0, 0, 0]
 
 

@@ -1,0 +1,120 @@
+"""Tracing subsystem (reference: tracing/tracing.go + handler/client
+inject-extract). Covers span nesting, nop fast path, and cross-node HTTP
+propagation through a live 2-node cluster query."""
+
+import pytest
+
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.logger import CaptureLogger
+
+
+@pytest.fixture
+def tracer():
+    t = tracing.InMemoryTracer()
+    tracing.set_tracer(t)
+    yield t
+    tracing.set_tracer(None)
+
+
+def test_nop_by_default():
+    tracing.set_tracer(None)
+    with tracing.start_span("x") as span:
+        assert span is None  # zero-allocation fast path
+    assert tracing.current_span() is None
+
+
+def test_span_nesting_and_finish(tracer):
+    with tracing.start_span("parent", index="i") as p:
+        with tracing.start_span("child") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+            assert tracing.current_span() is c
+        assert tracing.current_span() is p
+    assert tracing.current_span() is None
+    names = [s.name for s in tracer.spans]
+    assert names == ["child", "parent"]  # children finish first
+    assert all(s.duration is not None for s in tracer.spans)
+    assert tracer.find("parent")[0].tags == {"index": "i"}
+
+
+def test_inject_and_extract_headers(tracer):
+    assert tracing.inject_headers() == {}
+    with tracing.start_span("origin") as origin:
+        headers = tracing.inject_headers()
+        assert headers[tracing.TRACE_HEADER] == origin.trace_id
+        assert headers[tracing.PARENT_HEADER] == origin.span_id
+    with tracing.span_from_headers("remote", headers) as remote:
+        assert remote.trace_id == origin.trace_id
+        assert remote.parent_id == origin.span_id
+
+
+def test_span_from_headers_without_context(tracer):
+    with tracing.span_from_headers("h", {}) as span:
+        assert span.parent_id is None
+
+
+def test_executor_spans(tracer, tmp_path):
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path))
+    try:
+        h.client.create_index("ti")
+        h.client.create_field("ti", "f")
+        h.client.query("ti", "Set(1, f=10)")
+        h.client.query("ti", "Count(Row(f=10))")
+    finally:
+        h.close()
+    assert tracer.find("api.Query")
+    assert tracer.find("executor.Execute")
+    assert tracer.find("executor.executeCount")
+    # HTTP server spans carry the query trace id
+    http_spans = [s for s in tracer.spans if s.name.startswith("http.POST")]
+    assert http_spans
+    exec_span = tracer.find("executor.Execute")[-1]
+    assert any(s.trace_id == exec_span.trace_id for s in http_spans)
+
+
+def test_cross_node_trace_propagation(tracer):
+    """A fan-out query must carry one trace id through the remote node's
+    HTTP layer (reference: handler extractTracing / client inject)."""
+    from tests.harness import ClusterHarness
+
+    c = ClusterHarness(2)
+    try:
+        c[0].client.create_index("ti")
+        c[0].client.create_field("ti", "f")
+        # bits across two shards so the query fans out to both nodes
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        c[0].client.import_bits(
+            "ti", "f", [10, 10], [5, SHARD_WIDTH + 5])
+        # query via a node that does NOT own shard 0 -> remote fan-out
+        non_owner = c.non_owner_of("ti", 0)
+        tracer.clear()
+        assert non_owner.client.query(
+            "ti", "Count(Row(f=10))")["results"] == [2]
+    finally:
+        c.close()
+    remote_spans = [s for s in tracer.spans
+                    if s.name.startswith("http.POST") and s.parent_id]
+    assert remote_spans, "no remote http span continued a trace"
+    exec_spans = tracer.find("executor.Execute")
+    trace_ids = {s.trace_id for s in exec_spans}
+    assert any(s.trace_id in trace_ids for s in remote_spans)
+
+
+def test_slow_query_log(tmp_path):
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+
+    log = CaptureLogger()
+    holder = Holder(str(tmp_path))
+    holder.open()
+    try:
+        api = API(holder, long_query_time=0.0, logger=log)
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Count(Row(f=3))")
+    finally:
+        holder.close()
+    assert any("SLOW QUERY" in line and "Count" in line for line in log.lines)
