@@ -108,7 +108,20 @@ def cmd_server(args):
         # the primary's
         translate_repl = TranslateReplicator(
             holder, cluster, _Client).start()
-    server = PilosaHTTPServer(api, host=host, port=int(port or 10101))
+    # Metrics backend + runtime sampler (reference: server.go:419 stats
+    # selection; server.go:813 monitorRuntime).
+    from .utils.stats import RuntimeMonitor, build_stats
+
+    stats = build_stats(
+        getattr(args, "stats", None) or config.get("stats"),
+        statsd_host=getattr(args, "statsd_host", None)
+        or config.get("statsd-host"))
+    runtime_monitor = RuntimeMonitor(
+        stats, interval=parse_duration(
+            config.get("metric-poll-interval", "10s"))).start()
+
+    server = PilosaHTTPServer(
+        api, host=host, port=int(port or 10101), stats=stats)
     server.start()
     extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
@@ -119,6 +132,7 @@ def cmd_server(args):
     except KeyboardInterrupt:
         pass
     finally:
+        runtime_monitor.stop()
         if translate_repl:
             translate_repl.stop()
         if anti_entropy:
@@ -294,6 +308,12 @@ def main(argv=None):
     p.add_argument("--long-query-time", default=None,
                    help="log queries slower than this duration "
                         "(e.g. 500ms, 2s); disabled when unset")
+    p.add_argument("--stats", default=None,
+                   choices=["local", "statsd", "none"],
+                   help="metrics backend (default local registry; statsd "
+                        "also emits UDP datagrams)")
+    p.add_argument("--statsd-host", default=None,
+                   help="statsd host:port (default 127.0.0.1:8125)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")

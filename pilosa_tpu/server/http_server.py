@@ -27,10 +27,15 @@ class Route:
 class PilosaHTTPServer:
     """Owns the listening socket and the route table."""
 
-    def __init__(self, api, host="127.0.0.1", port=10101):
+    def __init__(self, api, host="127.0.0.1", port=10101, stats=None):
+        from ..utils.stats import global_stats
+
         self.api = api
         self.host = host
         self.port = port
+        # The configured metrics sink (reference: server.go:419); the
+        # global registry stays the default so /metrics always has data.
+        self.stats = stats if stats is not None else global_stats
         self.routes = self._build_routes()
         self._httpd = None
         self._thread = None
@@ -92,6 +97,7 @@ class PilosaHTTPServer:
             Route("POST", r"/cluster/resize/set-coordinator",
                   self._set_coordinator),
             Route("GET", r"/metrics", self._get_metrics),
+            Route("GET", r"/debug/vars", self._get_debug_vars),
         ]
 
     # -- handlers ------------------------------------------------------------
@@ -284,10 +290,18 @@ class PilosaHTTPServer:
         return self.api.set_coordinator(body.get("id"))
 
     def _get_metrics(self, req):
-        from ..utils.stats import global_stats
+        from ..utils.stats import registry_of
 
-        return RawResponse(global_stats.prometheus_text().encode(),
+        return RawResponse(registry_of(self.stats).prometheus_text().encode(),
                            "text/plain; version=0.0.4")
+
+    def _get_debug_vars(self, req):
+        """expvar-style JSON metrics (reference: /debug/vars route
+        http/handler.go:281)."""
+        from ..utils.stats import registry_of
+
+        return RawResponse(registry_of(self.stats).expvar_json().encode(),
+                           "application/json")
 
     # -- server lifecycle ----------------------------------------------------
 
@@ -328,7 +342,6 @@ class PilosaHTTPServer:
     # -- dispatch ------------------------------------------------------------
 
     def dispatch(self, handler):
-        from ..utils.stats import global_stats
         from ..utils import tracing
 
         parsed = urlparse(handler.path)
@@ -378,7 +391,7 @@ class PilosaHTTPServer:
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)
-        global_stats.timing(
+        self.stats.timing(
             "http_request_seconds", _time.perf_counter() - t0,
             {"path": path, "method": handler.command,
              "status": str(status)})

@@ -1,10 +1,17 @@
-"""Metrics (reference: stats/stats.go StatsClient + prometheus backend).
+"""Metrics (reference: stats/stats.go StatsClient iface + backends).
 
-A small counter/gauge/timing registry with Prometheus text exposition —
-the reference's pluggable StatsClient collapsed to one thread-safe
-implementation with the same call surface (count/gauge/timing, tags)."""
+The reference's pluggable StatsClient (stats/stats.go:31) with the same
+backend set: in-process registry with Prometheus/expvar exposition
+(prometheus/prometheus.go, stats.go:84), StatsD UDP emitter
+(statsd/statsd.go, DataDog-tagged datagrams), nop, and multi fan-out
+(stats.go:164). `RuntimeMonitor` is the runtime sampler loop
+(server.go:813-860, gcnotify/gopsutil analog) publishing process gauges."""
 
+import json
+import os
+import socket
 import threading
+import time
 from collections import defaultdict
 
 
@@ -60,6 +67,167 @@ class StatsClient:
             lines.append(fmt(f"pilosa_tpu_{name}_count", labels, count))
             lines.append(fmt(f"pilosa_tpu_{name}_sum", labels, total))
         return "\n".join(lines) + "\n"
+
+    def expvar_json(self):
+        """JSON snapshot (reference: expvar backend stats.go:84 + the
+        /debug/vars route http/handler.go:281)."""
+        counters, gauges, timings = self.snapshot()
+
+        def flat(d):
+            return {
+                (name if not labels else
+                 name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"):
+                    value
+                for (name, labels), value in sorted(d.items())}
+
+        return json.dumps({
+            "counters": flat(counters),
+            "gauges": flat(gauges),
+            "timings": {k: {"count": c, "sum": s}
+                        for k, (c, s) in flat(timings).items()},
+        })
+
+
+class NopStats:
+    """Discards everything (reference: nopStatsClient stats.go:54)."""
+
+    def count(self, name, value=1, tags=None):
+        pass
+
+    def gauge(self, name, value, tags=None):
+        pass
+
+    def timing(self, name, seconds, tags=None):
+        pass
+
+
+class StatsDClient:
+    """UDP StatsD emitter with DataDog-style |#k:v tags (reference:
+    statsd/statsd.go). Fire-and-forget: send errors are ignored, matching
+    UDP statsd semantics."""
+
+    def __init__(self, host="127.0.0.1", port=8125, prefix="pilosa_tpu"):
+        self.prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # Resolve once and connect() so the datagram hot path never
+            # does a DNS lookup (the http dispatch emits per request).
+            self._sock.connect((host, port))
+        except OSError:
+            pass  # unresolvable now; sends just drop (UDP semantics)
+
+    def _send(self, name, value, kind, tags):
+        msg = f"{self.prefix}.{name}:{value}|{kind}"
+        if tags:
+            msg += "|#" + ",".join(f"{k}:{v}" for k, v in sorted(tags.items()))
+        try:
+            self._sock.send(msg.encode())
+        except OSError:
+            pass
+
+    def count(self, name, value=1, tags=None):
+        self._send(name, value, "c", tags)
+
+    def gauge(self, name, value, tags=None):
+        self._send(name, value, "g", tags)
+
+    def timing(self, name, seconds, tags=None):
+        self._send(name, round(seconds * 1000, 3), "ms", tags)
+
+    def close(self):
+        self._sock.close()
+
+
+class MultiStats:
+    """Fans every metric out to several clients (reference: multiStatsClient
+    stats.go:164). The registry is usually first so exposition still works."""
+
+    def __init__(self, clients):
+        self.clients = list(clients)
+
+    def count(self, name, value=1, tags=None):
+        for c in self.clients:
+            c.count(name, value, tags)
+
+    def gauge(self, name, value, tags=None):
+        for c in self.clients:
+            c.gauge(name, value, tags)
+
+    def timing(self, name, seconds, tags=None):
+        for c in self.clients:
+            c.timing(name, seconds, tags)
+
+
+class RuntimeMonitor:
+    """Background sampler publishing process runtime gauges every interval
+    (reference: server.monitorRuntime server.go:813-860 — goroutines, heap,
+    GC; here: threads, RSS, fds, uptime from /proc)."""
+
+    def __init__(self, stats, interval=10.0):
+        self.stats = stats
+        # Event.wait(0) would busy-spin the sampler loop.
+        self.interval = max(float(interval), 1.0)
+        self._stop = threading.Event()
+        self._thread = None
+        self._t0 = time.time()
+
+    def sample(self):
+        self.stats.gauge("uptime_seconds", time.time() - self._t0)
+        self.stats.gauge("threads", threading.active_count())
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        self.stats.gauge(
+                            "rss_bytes", int(line.split()[1]) * 1024)
+                        break
+            self.stats.gauge("open_fds", len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass  # non-procfs platform
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self.sample()
+
+    def start(self):
+        self.sample()
+        self._thread = threading.Thread(
+            target=self._run, name="pilosa-runtime-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def registry_of(stats):
+    """The exposition-capable registry behind a configured stats client
+    (a MultiStats wraps one; NopStats has none -> global registry)."""
+    if isinstance(stats, StatsClient):
+        return stats
+    if isinstance(stats, MultiStats):
+        for c in stats.clients:
+            if isinstance(c, StatsClient):
+                return c
+    return global_stats
+
+
+def build_stats(kind, statsd_host=None, registry=None):
+    """Config-selected backend (reference: server.go:419 NewStatsClient).
+    `kind`: "local" (registry only, default), "statsd" (registry + UDP so
+    /metrics keeps working), "none", or "expvar" (alias of local)."""
+    registry = registry if registry is not None else global_stats
+    if kind in (None, "", "local", "expvar", "prometheus"):
+        return registry
+    if kind == "none":
+        return NopStats()
+    if kind == "statsd":
+        host, _, port = (statsd_host or "127.0.0.1:8125").partition(":")
+        return MultiStats(
+            [registry, StatsDClient(host, int(port or 8125))])
+    raise ValueError(f"unknown stats backend {kind!r}")
 
 
 global_stats = StatsClient()

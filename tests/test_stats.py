@@ -1,0 +1,137 @@
+"""Stats backends (reference: stats/stats.go, statsd/statsd.go,
+prometheus/prometheus.go, server.monitorRuntime server.go:813)."""
+
+import json
+import socket
+
+from pilosa_tpu.utils.stats import (
+    MultiStats,
+    NopStats,
+    RuntimeMonitor,
+    StatsClient,
+    StatsDClient,
+    build_stats,
+)
+
+
+def test_registry_and_prometheus_text():
+    s = StatsClient()
+    s.count("queries", 2, tags={"index": "i"})
+    s.gauge("shards", 5)
+    s.timing("exec_seconds", 0.25)
+    text = s.prometheus_text()
+    assert 'pilosa_tpu_queries_total{index="i"} 2' in text
+    assert "pilosa_tpu_shards 5" in text
+    assert "pilosa_tpu_exec_seconds_count 1" in text
+    assert "pilosa_tpu_exec_seconds_sum 0.25" in text
+
+
+def test_expvar_json():
+    s = StatsClient()
+    s.count("q", 1)
+    s.gauge("g", 2, tags={"a": "b"})
+    s.timing("t", 0.5)
+    data = json.loads(s.expvar_json())
+    assert data["counters"]["q"] == 1
+    assert data["gauges"]["g{a=b}"] == 2
+    assert data["timings"]["t"] == {"count": 1, "sum": 0.5}
+
+
+def test_statsd_datagrams():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5)
+    port = recv.getsockname()[1]
+    c = StatsDClient("127.0.0.1", port)
+    try:
+        c.count("queries", 3, tags={"index": "i"})
+        c.gauge("shards", 7)
+        c.timing("exec", 0.5)
+        got = sorted(recv.recv(1024).decode() for _ in range(3))
+        assert got == [
+            "pilosa_tpu.exec:500.0|ms",
+            "pilosa_tpu.queries:3|c|#index:i",
+            "pilosa_tpu.shards:7|g",
+        ]
+    finally:
+        c.close()
+        recv.close()
+
+
+def test_multi_and_nop():
+    reg = StatsClient()
+    multi = MultiStats([reg, NopStats()])
+    multi.count("x")
+    multi.gauge("y", 1)
+    multi.timing("z", 0.1)
+    counters, gauges, timings = reg.snapshot()
+    assert counters and gauges and timings
+
+
+def test_build_stats_selection():
+    reg = StatsClient()
+    assert build_stats("local", registry=reg) is reg
+    assert isinstance(build_stats("none"), NopStats)
+    multi = build_stats("statsd", statsd_host="127.0.0.1:9", registry=reg)
+    assert isinstance(multi, MultiStats) and multi.clients[0] is reg
+    multi.clients[1].close()
+
+
+def test_runtime_monitor_samples():
+    reg = StatsClient()
+    mon = RuntimeMonitor(reg, interval=1000)
+    mon.start()
+    mon.stop()
+    _, gauges, _ = reg.snapshot()
+    names = {name for name, _ in gauges}
+    assert "uptime_seconds" in names
+    assert "threads" in names
+    import os
+
+    if os.path.exists("/proc/self/status"):
+        assert "rss_bytes" in names
+
+
+def test_registry_of():
+    from pilosa_tpu.utils.stats import global_stats, registry_of
+
+    reg = StatsClient()
+    assert registry_of(reg) is reg
+    assert registry_of(MultiStats([NopStats(), reg])) is reg
+    assert registry_of(NopStats()) is global_stats
+
+
+def test_server_exposes_injected_registry(tmp_path):
+    """Metrics routes must read the server's configured registry, not the
+    global one."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http_server import PilosaHTTPServer
+    from pilosa_tpu.server.client import Client
+
+    holder = Holder(str(tmp_path)).open()
+    reg = StatsClient()
+    reg.count("private_marker", 42)
+    srv = PilosaHTTPServer(API(holder), host="127.0.0.1", port=0,
+                           stats=reg).start()
+    try:
+        text = Client(srv.address)._request("GET", "/metrics")
+        assert b"pilosa_tpu_private_marker_total 42" in text
+    finally:
+        srv.stop()
+        holder.close()
+
+
+def test_server_exposes_debug_vars(tmp_path):
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path))
+    try:
+        h.client.create_index("i")
+        data = h.client._request("GET", "/debug/vars")
+        assert "counters" in data and "timings" in data
+        # the request itself was timed into the registry
+        text = h.client._request("GET", "/metrics")
+        assert b"http_request_seconds" in text
+    finally:
+        h.close()
