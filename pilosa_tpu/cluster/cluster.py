@@ -101,13 +101,19 @@ class Cluster:
         return any(n.id == node_id for n in self.shard_nodes(index, shard))
 
     def shards_by_node(self, index, shards):
-        """{node: [shards]} using each shard's PRIMARY owner (readers retry
-        replicas on failure; reference: executor.shardsByNode)."""
+        """{node: [shards]} using each shard's first NON-DOWN owner (reads
+        stay available in DEGRADED state by routing straight to a live
+        replica instead of timing out on the primary; reference:
+        executor.shardsByNode + the replica-retry path executor.go:2490).
+        Falls back to the primary when every owner is down so the caller
+        surfaces a clean error."""
         out = {}
         for shard in shards:
             owners = self.shard_nodes(index, shard)
-            if owners:
-                out.setdefault(owners[0], []).append(shard)
+            if not owners:
+                continue
+            live = [n for n in owners if n.state != NODE_STATE_DOWN]
+            out.setdefault((live or owners)[0], []).append(shard)
         return out
 
     def local_shards(self, index, shards):

@@ -331,13 +331,23 @@ class ClusterExecutor:
         shards = set(idx.available_shards())
         lock = threading.Lock()
 
+        from .node import NODE_STATE_DOWN
+
         def fetch(node):
             try:
-                resp = self._client(node).index_shards(idx.name)
+                client = self._client(node)
+                if node.state == NODE_STATE_DOWN:
+                    # Probe DOWN-marked peers with a short deadline: a
+                    # healed-but-not-yet-READY node still contributes its
+                    # exclusive shards; a truly dead one costs ~2s, not a
+                    # full client timeout. Shards it shares with replicas
+                    # surface from their fetches regardless.
+                    client.timeout = 2
+                resp = client.index_shards(idx.name)
                 with lock:
                     shards.update(resp.get("shards", []))
             except Exception:
-                pass  # down node: its exclusive shards surface via retry
+                pass  # unreachable: replicated shards come from peers
 
         threads = [threading.Thread(target=fetch, args=(n,))
                    for n in self.cluster.peers()]

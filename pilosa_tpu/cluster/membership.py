@@ -73,6 +73,11 @@ class HealthMonitor:
     def _check(self, node):
         try:
             client = self.client_factory(node.uri)
+            # Probes need a tight deadline (reference: memberlist probe
+            # timeouts are sub-second); inheriting the default 30s client
+            # timeout would stall down-detection by minutes.
+            if hasattr(client, "timeout"):
+                client.timeout = 2
             status = client.status()
             return isinstance(status, dict)
         except Exception:

@@ -330,10 +330,76 @@ class API:
         remotes = [n for n in owners if n.id != self.cluster.local_id]
         return local, remotes
 
+    def _fan_out_writes(self, jobs, covered_locally, count_shards=()):
+        """Run remote import forwards (one worker per TARGET NODE, its jobs
+        sequential — bounded like the executor's per-node mapReduce fan-out)
+        and apply the degraded-write policy.
+
+        `jobs`: list of (shard, node, thunk). A forward failure is tolerated
+        as long as the shard reached at least one owner (this node or
+        another replica) — the lagging replica is repaired by anti-entropy
+        (reference: DEGRADED semantics cluster.go:571-583 + fragment
+        syncer). A shard that reached NO owner fails the import.
+
+        `count_shards`: shards NOT applied locally; returns their total
+        logical change count taken from replica responses (replicas report
+        the same count, so max per shard).
+        """
+        import threading
+
+        results, errors = {}, {}
+        lock = threading.Lock()
+        by_node = {}
+        for shard, node, thunk in jobs:
+            by_node.setdefault(node.id, (node, []))[1].append((shard, thunk))
+
+        def run(node, node_jobs):
+            for shard, thunk in node_jobs:
+                if getattr(node, "state", None) == "DOWN":
+                    # health monitor flagged the node mid-import: don't
+                    # burn a full timeout per remaining shard
+                    with lock:
+                        errors[(shard, node.id)] = ApiError(
+                            f"node {node.id} is down")
+                    continue
+                try:
+                    resp = thunk()
+                    with lock:
+                        results[(shard, node.id)] = resp
+                except Exception as e:
+                    with lock:
+                        errors[(shard, node.id)] = e
+
+        threads = [threading.Thread(target=run, args=pair)
+                   for pair in by_node.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        reached = set(covered_locally)
+        reached.update(shard for shard, _ in results)
+        failed = sorted({s for (s, _) in errors} - reached)
+        if failed:
+            cause = next(e for (s, _), e in errors.items() if s in failed)
+            raise ApiError(
+                f"import failed: no reachable owner for shards {failed}: "
+                f"{cause}")
+        for (shard, node_id), e in errors.items():
+            self.logger.printf(
+                "import: replica %s unreachable for shard %d (%s); "
+                "anti-entropy will repair", node_id, shard, e)
+        remote_changed = {s: 0 for s in count_shards}
+        for (shard, _), resp in results.items():
+            if shard in remote_changed and isinstance(resp, dict):
+                remote_changed[shard] = max(
+                    remote_changed[shard], resp.get("changed", 0))
+        return results, sum(remote_changed.values())
+
     def import_bits(self, index_name, field_name, row_ids, column_ids,
                     timestamps=None, clear=False, remote=False):
         """(reference: api.Import api.go:920 — sort bits by shard, forward
-        each slice to all replica owners)"""
+        each slice to all replica owners concurrently)"""
         self._validate_state()
         field = self._field(index_name, field_name)
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
@@ -350,38 +416,38 @@ class API:
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         shards = column_ids // np.uint64(SHARD_WIDTH)
         changed = 0
+        jobs, covered, remote_only = [], set(), set()
         for shard in np.unique(shards):
+            shard = int(shard)
             mask = shards == shard
-            local, remotes = self._route_import(index_name, int(shard))
+            local, remotes = self._route_import(index_name, shard)
             slice_rows = row_ids[mask]
             slice_cols = column_ids[mask]
             slice_ts = None
             if timestamps is not None:
                 ts_arr = np.asarray(timestamps, dtype=object)
                 slice_ts = ts_arr[mask].tolist()
-            shard_changed = 0
             if local:
-                shard_changed = field.import_bits(
+                changed += field.import_bits(
                     slice_rows, slice_cols, timestamps=slice_ts, clear=clear)
                 self.holder.index(index_name).add_existence(slice_cols)
-            if remotes:
-                wire_ts = None
-                if slice_ts is not None:
-                    wire_ts = [
-                        t.strftime(TIME_FORMAT) if t is not None else None
-                        for t in slice_ts]
-                for node in remotes:
-                    resp = self.client_factory(node.uri).import_bits(
-                        index_name, field_name, slice_rows.tolist(),
-                        slice_cols.tolist(), timestamps=wire_ts, clear=clear,
-                        remote=True)
-                    if not local and isinstance(resp, dict):
-                        # replicas report the same logical change count;
-                        # use it when this node didn't apply locally
-                        shard_changed = max(
-                            shard_changed, resp.get("changed", 0))
-            changed += shard_changed
-        return changed
+                covered.add(shard)
+            else:
+                remote_only.add(shard)
+            wire_ts = None
+            if slice_ts is not None:
+                wire_ts = [
+                    t.strftime(TIME_FORMAT) if t is not None else None
+                    for t in slice_ts]
+            for node in remotes:
+                jobs.append((shard, node, (
+                    lambda n=node, r=slice_rows, c=slice_cols, w=wire_ts:
+                    self.client_factory(n.uri).import_bits(
+                        index_name, field_name, r.tolist(), c.tolist(),
+                        timestamps=w, clear=clear, remote=True))))
+        _, remote_changed = self._fan_out_writes(
+            jobs, covered, count_shards=remote_only)
+        return changed + remote_changed
 
     def import_values(self, index_name, field_name, column_ids, values,
                       remote=False):
@@ -398,22 +464,27 @@ class API:
         values = np.asarray(values, dtype=np.int64)
         shards = column_ids // np.uint64(SHARD_WIDTH)
         changed = 0
+        jobs, covered, remote_only = [], set(), set()
         for shard in np.unique(shards):
+            shard = int(shard)
             mask = shards == shard
-            local, remotes = self._route_import(index_name, int(shard))
-            shard_changed = 0
+            local, remotes = self._route_import(index_name, shard)
             if local:
-                shard_changed = field.import_values(
+                changed += field.import_values(
                     column_ids[mask], values[mask])
                 self.holder.index(index_name).add_existence(column_ids[mask])
+                covered.add(shard)
+            else:
+                remote_only.add(shard)
             for node in remotes:
-                resp = self.client_factory(node.uri).import_values(
-                    index_name, field_name, column_ids[mask].tolist(),
-                    values[mask].tolist(), remote=True)
-                if not local and isinstance(resp, dict):
-                    shard_changed = max(shard_changed, resp.get("changed", 0))
-            changed += shard_changed
-        return changed
+                jobs.append((shard, node, (
+                    lambda n=node, c=column_ids[mask], v=values[mask]:
+                    self.client_factory(n.uri).import_values(
+                        index_name, field_name, c.tolist(), v.tolist(),
+                        remote=True))))
+        _, remote_changed = self._fan_out_writes(
+            jobs, covered, count_shards=remote_only)
+        return changed + remote_changed
 
     def import_roaring(self, index_name, field_name, shard, data,
                        clear=False, view="standard", remote=False):
@@ -429,13 +500,14 @@ class API:
             v = field.create_view_if_not_exists(view)
             frag = v.create_fragment_if_not_exists(shard)
             changed = frag.import_roaring(data, clear=clear)
-        for node in remotes:
-            resp = self.client_factory(node.uri).import_roaring(
+        jobs = [(shard, node, (
+            lambda n=node: self.client_factory(n.uri).import_roaring(
                 index_name, field_name, shard, data, clear=clear, view=view,
-                remote=True)
-            if not local and isinstance(resp, dict):
-                changed = max(changed, resp.get("changed", 0))
-        return changed
+                remote=True))) for node in remotes]
+        _, remote_changed = self._fan_out_writes(
+            jobs, {shard} if local else set(),
+            count_shards=() if local else {shard})
+        return changed if local else remote_changed
 
     def _field(self, index_name, field_name):
         idx = self.holder.index(index_name)

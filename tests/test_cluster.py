@@ -392,3 +392,27 @@ class TestReplication:
                     node.close()
                 except Exception:
                     pass
+
+
+def test_shards_by_node_skips_down_primary():
+    """Reads route to a live replica when the primary is DOWN (degraded
+    reads; reference: executor.go:2490 replica retry + DEGRADED state)."""
+    from pilosa_tpu.cluster import Cluster, Node
+    from pilosa_tpu.cluster.node import NODE_STATE_DOWN
+
+    nodes = [Node(id=f"n{i}", uri=f"http://h{i}") for i in range(3)]
+    c = Cluster(nodes=nodes, local_id="n0", replica_n=2)
+    shards = list(range(8))
+    normal = c.shards_by_node("i", shards)
+    # mark one node down: its shards must move to their next replica
+    victim = next(iter(normal))
+    c.set_node_state(victim.id, NODE_STATE_DOWN)
+    degraded = c.shards_by_node("i", shards)
+    assert victim not in degraded
+    assert sorted(s for ss in degraded.values() for s in ss) == shards
+    # all nodes down for a shard -> falls back to primary (error surfaces)
+    for n in nodes:
+        c.set_node_state(n.id, NODE_STATE_DOWN)
+    assert sorted(
+        s for ss in c.shards_by_node("i", shards).values() for s in ss
+    ) == shards
