@@ -120,6 +120,21 @@ def cmd_server(args):
         stats, interval=parse_duration(
             config.get("metric-poll-interval", "10s"))).start()
 
+    # Diagnostics phone-home: opt-in only, requires an explicit endpoint
+    # (reference: diagnostics.go + server.go:760; default ON there, OFF
+    # here — no default public endpoint).
+    diagnostics = None
+    diag_cfg = config.get("diagnostics", {})
+    if isinstance(diag_cfg, dict) and diag_cfg.get("enabled") \
+            and diag_cfg.get("endpoint"):
+        from .server.diagnostics import Diagnostics
+        from .utils.logger import StandardLogger
+
+        diagnostics = Diagnostics(
+            api, diag_cfg["endpoint"],
+            interval=parse_duration(diag_cfg.get("interval", "1h")),
+            logger=StandardLogger()).start()
+
     server = PilosaHTTPServer(
         api, host=host, port=int(port or 10101), stats=stats)
     server.start()
@@ -132,6 +147,8 @@ def cmd_server(args):
     except KeyboardInterrupt:
         pass
     finally:
+        if diagnostics:
+            diagnostics.stop()
         runtime_monitor.stop()
         if translate_repl:
             translate_repl.stop()

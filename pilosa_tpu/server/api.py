@@ -347,7 +347,9 @@ class API:
         """
         import threading
 
-        results, errors = {}, {}
+        from ..cluster.node import NODE_STATE_DOWN
+
+        results, errors, skipped = {}, {}, {}
         lock = threading.Lock()
         by_node = {}
         for shard, node, thunk in jobs:
@@ -355,12 +357,14 @@ class API:
 
         def run(node, node_jobs):
             for shard, thunk in node_jobs:
-                if getattr(node, "state", None) == "DOWN":
+                if getattr(node, "state", None) == NODE_STATE_DOWN:
                     # health monitor flagged the node mid-import: don't
-                    # burn a full timeout per remaining shard
+                    # burn a full timeout per remaining shard (retried
+                    # below only if the shard reaches no other owner)
                     with lock:
                         errors[(shard, node.id)] = ApiError(
                             f"node {node.id} is down")
+                        skipped[(shard, node.id)] = thunk
                     continue
                 try:
                     resp = thunk()
@@ -377,9 +381,21 @@ class API:
         for t in threads:
             t.join()
 
-        reached = set(covered_locally)
-        reached.update(shard for shard, _ in results)
-        failed = sorted({s for (s, _) in errors} - reached)
+        def uncovered():
+            reached = set(covered_locally)
+            reached.update(shard for shard, _ in results)
+            return sorted({s for (s, _) in errors} - reached)
+
+        # A DOWN mark can be a false positive; when a skipped node was a
+        # shard's ONLY owner, attempt the send anyway before failing.
+        for (shard, node_id), thunk in skipped.items():
+            if shard in uncovered():
+                try:
+                    results[(shard, node_id)] = thunk()
+                    del errors[(shard, node_id)]
+                except Exception as e:
+                    errors[(shard, node_id)] = e
+        failed = uncovered()
         if failed:
             cause = next(e for (s, _), e in errors.items() if s in failed)
             raise ApiError(

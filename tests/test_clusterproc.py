@@ -48,9 +48,7 @@ class ProcCluster:
         self.dirs = [tempfile.mkdtemp(prefix="pilosa-proc-") for _ in range(n)]
         self.procs = []
         self.logs = []
-        env = dict(os.environ,
-                   JAX_PLATFORMS="cpu",
-                   PILOSA_TPU_ANTI_ENTROPY=anti_entropy)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
         for i, port in enumerate(self.ports):
             cfg = os.path.join(self.dirs[i], "config.toml")
             with open(cfg, "w") as f:
@@ -116,6 +114,10 @@ class ProcCluster:
                 p.kill()
         for log in self.logs:
             log.close()
+        import shutil
+
+        for d in self.dirs:
+            shutil.rmtree(d, ignore_errors=True)
 
 
 @pytest.fixture(scope="module")

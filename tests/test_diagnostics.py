@@ -1,0 +1,99 @@
+"""Diagnostics phone-home (reference: diagnostics.go + loop server.go:760).
+Posts go to a local in-test HTTP endpoint — nothing external."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.diagnostics import Diagnostics, _version_tuple
+from pilosa_tpu.utils.logger import CaptureLogger
+
+
+@pytest.fixture
+def sink():
+    """Local endpoint that records diagnostics payloads and answers with a
+    configurable version."""
+    received = []
+    reply = {"version": "0.0.0"}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            body = json.dumps(reply).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/diag"
+    yield received, reply, url
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _api(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder)
+    api.create_index("d1")
+    api.create_field("d1", "f")
+    api.import_bits("d1", "f", [1], [5])
+    return holder, api
+
+
+def test_payload_is_anonymized(tmp_path, sink):
+    received, reply, url = sink
+    holder, api = _api(tmp_path)
+    try:
+        d = Diagnostics(api, url)
+        p = d.payload()
+        assert p["numIndexes"] == 1 and p["numFields"] >= 1
+        assert p["numShards"] == 1 and p["numNodes"] == 1
+        # nothing identifying: no names, uris, or keys anywhere
+        blob = json.dumps(p)
+        assert "d1" not in blob and "uri" not in blob
+    finally:
+        holder.close()
+
+
+def test_flush_posts_and_checks_version(tmp_path, sink):
+    received, reply, url = sink
+    reply["version"] = "99.0.0"
+    holder, api = _api(tmp_path)
+    log = CaptureLogger()
+    try:
+        d = Diagnostics(api, url, logger=log)
+        d.flush()
+        assert len(received) == 1
+        assert received[0]["version"]
+        assert any("newer" in line for line in log.lines)
+    finally:
+        holder.close()
+
+
+def test_flush_survives_dead_endpoint(tmp_path):
+    holder, api = _api(tmp_path)
+    try:
+        d = Diagnostics(api, "http://127.0.0.1:9/nope")
+        d.flush()  # must not raise
+        assert d.last_response is None
+    finally:
+        holder.close()
+
+
+def test_version_compare():
+    assert _version_tuple("v1.2.3") == (1, 2, 3)
+    d = Diagnostics.__new__(Diagnostics)
+    d.logger = CaptureLogger()
+    assert d.check_version({"version": "0.0.1"}) is False
+    assert d.check_version({}) is False
