@@ -1,0 +1,154 @@
+"""Protobuf serializer for the query data plane.
+
+Wire-compatible with the reference (encoding/proto/proto.go:29-45
+Serializer; QueryResult type tags :1055-1067), so a stock Pilosa client
+POSTing `Content-Type: application/x-protobuf` QueryRequests receives
+byte-compatible QueryResponses. Regenerate bindings with
+`protoc --python_out=. pilosa.proto` in this directory.
+"""
+
+from . import pilosa_pb2 as pb
+
+CONTENT_TYPE_PROTOBUF = "application/x-protobuf"
+
+# QueryResult.Type tags (reference: encoding/proto/proto.go:1055-1067)
+TYPE_NIL = 0
+TYPE_ROW = 1
+TYPE_PAIRS = 2
+TYPE_VALCOUNT = 3
+TYPE_UINT64 = 4
+TYPE_BOOL = 5
+TYPE_ROWIDS = 6
+TYPE_GROUPCOUNTS = 7
+TYPE_ROWIDENTIFIERS = 8
+TYPE_PAIR = 9
+
+
+# -- requests ---------------------------------------------------------------
+
+def encode_query_request(query, shards=None, remote=False,
+                         column_attrs=False):
+    m = pb.QueryRequest(Query=query, Remote=remote, ColumnAttrs=column_attrs)
+    if shards:
+        m.Shards.extend(int(s) for s in shards)
+    return m.SerializeToString()
+
+
+def decode_query_request(data):
+    m = pb.QueryRequest.FromString(data)
+    return {
+        "query": m.Query,
+        "shards": list(m.Shards) or None,
+        "remote": m.Remote,
+        "column_attrs": m.ColumnAttrs,
+    }
+
+
+# -- results ----------------------------------------------------------------
+
+def _encode_result(result, out):
+    from ..core.row import Row
+    from ..exec.result import GroupCount, Pair, RowIdentifiers, ValCount
+
+    if result is None:
+        out.Type = TYPE_NIL
+    elif isinstance(result, Row):
+        out.Type = TYPE_ROW
+        out.Row.Columns.extend(int(c) for c in result.columns())
+        if result.keys is not None:
+            out.Row.Keys.extend(result.keys)
+    elif isinstance(result, bool):
+        out.Type = TYPE_BOOL
+        out.Changed = result
+    elif isinstance(result, int):
+        out.Type = TYPE_UINT64
+        out.N = result
+    elif isinstance(result, ValCount):
+        out.Type = TYPE_VALCOUNT
+        out.ValCount.Val = result.val
+        out.ValCount.Count = result.count
+    elif isinstance(result, Pair):
+        out.Type = TYPE_PAIR
+        _set_pair(out.Pairs.add(), result)
+    elif isinstance(result, RowIdentifiers):
+        out.Type = TYPE_ROWIDENTIFIERS
+        out.RowIdentifiers.Rows.extend(int(r) for r in result.rows)
+        if result.keys is not None:
+            out.RowIdentifiers.Keys.extend(result.keys)
+    elif isinstance(result, list) and result and isinstance(
+            result[0], GroupCount):
+        out.Type = TYPE_GROUPCOUNTS
+        for gc in result:
+            g = out.GroupCounts.add()
+            g.Count = gc.count
+            for fr in gc.group:
+                f = g.Group.add()
+                f.Field = fr.field
+                f.RowID = fr.row_id
+                if fr.row_key is not None:
+                    f.RowKey = fr.row_key
+    elif isinstance(result, list):
+        # Pairs (TopN) — possibly empty; empty lists encode as empty pairs
+        out.Type = TYPE_PAIRS
+        for p in result:
+            _set_pair(out.Pairs.add(), p)
+    else:
+        raise ValueError(f"unencodable result type {type(result)!r}")
+
+
+def _set_pair(slot, p):
+    slot.ID = p.id
+    slot.Count = p.count
+    if p.key is not None:
+        slot.Key = p.key
+
+
+def _decode_result(m):
+    from ..exec.result import (
+        FieldRow, GroupCount, Pair, RowIdentifiers, ValCount)
+
+    t = m.Type
+    if t == TYPE_NIL:
+        return None
+    if t == TYPE_ROW:
+        out = {"columns": list(m.Row.Columns)}
+        if m.Row.Keys:
+            out["keys"] = list(m.Row.Keys)
+        return out
+    if t == TYPE_BOOL:
+        return m.Changed
+    if t == TYPE_UINT64:
+        return m.N
+    if t == TYPE_VALCOUNT:
+        return ValCount(m.ValCount.Val, m.ValCount.Count)
+    if t == TYPE_PAIR:
+        p = m.Pairs[0]
+        return Pair(p.ID, p.Count, p.Key or None)
+    if t == TYPE_PAIRS:
+        return [Pair(p.ID, p.Count, p.Key or None) for p in m.Pairs]
+    if t == TYPE_ROWIDENTIFIERS:
+        return RowIdentifiers(
+            list(m.RowIdentifiers.Rows),
+            list(m.RowIdentifiers.Keys) or None)
+    if t == TYPE_GROUPCOUNTS:
+        return [GroupCount(
+            [FieldRow(f.Field, f.RowID, f.RowKey or None) for f in g.Group],
+            g.Count) for g in m.GroupCounts]
+    raise ValueError(f"unknown QueryResult type {t}")
+
+
+def encode_query_response(results, err=None):
+    m = pb.QueryResponse()
+    if err:
+        m.Err = str(err)
+    for r in results or []:
+        _encode_result(r, m.Results.add())
+    return m.SerializeToString()
+
+
+def decode_query_response(data):
+    """-> (results list, err string or None). Row results decode to the
+    JSON-ish dict shape (columns/keys) since the wire Row has no segment
+    structure."""
+    m = pb.QueryResponse.FromString(data)
+    return [_decode_result(r) for r in m.Results], (m.Err or None)

@@ -15,9 +15,24 @@ class ClientError(Exception):
 
 
 class Client:
-    def __init__(self, base_url, timeout=30):
+    def __init__(self, base_url, timeout=30, tls_skip_verify=False,
+                 ca_cert=None):
+        """tls_skip_verify / ca_cert: https trust options (reference:
+        tls.skip-verify / tls.ca-certificate server config)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._ssl_context = None
+        if base_url.startswith("https"):
+            import ssl
+
+            if tls_skip_verify:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                self._ssl_context = ctx
+            elif ca_cert:
+                self._ssl_context = ssl.create_default_context(
+                    cafile=ca_cert)
 
     def _request(self, method, path, body=None, content_type="application/json"):
         from ..utils import tracing
@@ -29,7 +44,9 @@ class Client:
         for k, v in tracing.inject_headers().items():
             req.add_header(k, v)  # cross-node trace context (client inject)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout,
+                    context=self._ssl_context) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
@@ -64,6 +81,19 @@ class Client:
         return self._request("GET", "/schema")
 
     # -- queries -------------------------------------------------------------
+
+    def query_proto(self, index, pql, shards=None, remote=False):
+        """Query over the protobuf data plane (reference:
+        InternalClient.QueryNode posts proto QueryRequests). Returns
+        (results, err)."""
+        from .. import encoding
+
+        body = encoding.encode_query_request(pql, shards=shards,
+                                             remote=remote)
+        data = self._request(
+            "POST", f"/index/{index}/query", body,
+            content_type=encoding.CONTENT_TYPE_PROTOBUF)
+        return encoding.decode_query_response(data)
 
     def query(self, index, pql, shards=None, remote=False):
         """(reference: InternalClient.QueryNode http/client.go:268; remote

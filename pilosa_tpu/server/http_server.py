@@ -27,7 +27,8 @@ class Route:
 class PilosaHTTPServer:
     """Owns the listening socket and the route table."""
 
-    def __init__(self, api, host="127.0.0.1", port=10101, stats=None):
+    def __init__(self, api, host="127.0.0.1", port=10101, stats=None,
+                 tls_cert=None, tls_key=None):
         from ..utils.stats import global_stats
 
         self.api = api
@@ -36,6 +37,9 @@ class PilosaHTTPServer:
         # The configured metrics sink (reference: server.go:419); the
         # global registry stays the default so /metrics always has data.
         self.stats = stats if stats is not None else global_stats
+        # TLS (reference: server/tlsconfig.go; config tls.certificate/key)
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self.routes = self._build_routes()
         self._httpd = None
         self._thread = None
@@ -147,6 +151,22 @@ class PilosaHTTPServer:
 
     def _post_query(self, req):
         from ..exec import ExecOptions
+
+        if req.content_type.startswith("application/x-protobuf"):
+            # protobuf data plane, wire-compatible with the reference's
+            # QueryRequest/QueryResponse (encoding/proto/proto.go)
+            from .. import encoding
+
+            q = encoding.decode_query_request(req.body)
+            options = ExecOptions(remote=True) if q["remote"] else None
+            try:
+                results = self.api.query(
+                    req.params["index"], q["query"], shards=q["shards"],
+                    options=options)
+                body = encoding.encode_query_response(results)
+            except ApiError as e:
+                body = encoding.encode_query_response([], err=str(e))
+            return RawResponse(body, encoding.CONTENT_TYPE_PROTOBUF)
 
         pql = req.body.decode("utf-8")
         shards = None
@@ -320,6 +340,17 @@ class PilosaHTTPServer:
             do_GET = do_POST = do_DELETE = _dispatch
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert, self.tls_key)
+            # Defer the handshake to the per-connection worker thread
+            # (first read); a handshake in accept() would let one stalled
+            # client block ALL new connections.
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="pilosa-http", daemon=True)
@@ -337,7 +368,8 @@ class PilosaHTTPServer:
 
     @property
     def address(self):
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls_cert else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     # -- dispatch ------------------------------------------------------------
 
@@ -361,7 +393,8 @@ class PilosaHTTPServer:
             m = route.regex.match(path)
             if m is None:
                 continue
-            req = Request(m.groupdict(), query, body)
+            req = Request(m.groupdict(), query, body,
+                          handler.headers.get("Content-Type", ""))
             # Continue a cross-node trace from incoming headers (reference:
             # http/handler.go:321 extractTracing middleware).
             with tracing.span_from_headers(
@@ -398,12 +431,13 @@ class PilosaHTTPServer:
 
 
 class Request:
-    __slots__ = ("params", "query", "body")
+    __slots__ = ("params", "query", "body", "content_type")
 
-    def __init__(self, params, query, body):
+    def __init__(self, params, query, body, content_type=""):
         self.params = params
         self.query = query
         self.body = body
+        self.content_type = content_type
 
     def json(self):
         if not self.body:

@@ -1,0 +1,71 @@
+"""TLS serving (reference: server/tlsconfig.go, tls.certificate/key
+config). Uses a self-signed cert generated with the openssl binary; skipped
+when openssl is unavailable."""
+
+import shutil
+import subprocess
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.server.http_server import PilosaHTTPServer
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("openssl") is None, reason="openssl not available")
+
+
+@pytest.fixture
+def certs(tmp_path):
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_https_end_to_end(tmp_path, certs):
+    cert, key = certs
+    holder = Holder(str(tmp_path / "data")).open()
+    srv = PilosaHTTPServer(API(holder), host="127.0.0.1", port=0,
+                           tls_cert=cert, tls_key=key).start()
+    try:
+        assert srv.address.startswith("https://")
+        client = Client(srv.address, ca_cert=cert)
+        client.create_index("t")
+        client.create_field("t", "f")
+        client.query("t", "Set(1, f=2)")
+        assert client.query("t", "Count(Row(f=2))")["results"] == [1]
+        # skip-verify mode also works (self-signed without the CA)
+        c2 = Client(srv.address, tls_skip_verify=True)
+        assert c2.query("t", "Count(Row(f=2))")["results"] == [1]
+    finally:
+        srv.stop()
+        holder.close()
+
+
+def test_stalled_client_does_not_block_accept(tmp_path, certs):
+    """A TCP client that never sends a ClientHello must not wedge the
+    accept loop (handshake is deferred to the worker thread)."""
+    import socket
+
+    cert, key = certs
+    holder = Holder(str(tmp_path / "data2")).open()
+    srv = PilosaHTTPServer(API(holder), host="127.0.0.1", port=0,
+                           tls_cert=cert, tls_key=key).start()
+    try:
+        stalled = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            client = Client(srv.address, ca_cert=cert, timeout=10)
+            client.create_index("t2")
+            assert "t2" in {i["name"]
+                            for i in client.schema()["indexes"]}
+        finally:
+            stalled.close()
+    finally:
+        srv.stop()
+        holder.close()
