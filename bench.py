@@ -71,37 +71,54 @@ def main():
                           "error": "correctness check failed"}))
         sys.exit(1)
 
+    # Serving workload: every query is DISTINCT (real servers answer varied
+    # queries; repeating one identical call would let any result cache in
+    # the stack answer from memory). Each query intersects `a` with a
+    # different shard-rotation of `b` — same bytes moved, different result,
+    # still one fused XLA dispatch.
+    @jax.jit
+    def query(a, b, i):
+        rolled = jnp.roll(b, i, axis=0)
+        return jnp.sum(
+            jax.lax.population_count(a & rolled).astype(jnp.int32))
+
+    idx = jnp.arange(1024)
+    query(a, b, idx[0]).block_until_ready()  # compile
+
     # Throughput: pipelined serving — queries dispatch asynchronously (as a
     # loaded server overlaps concurrent queries) and all results are
     # delivered before the clock stops. Latency: per-query with a full
     # device->host sync each call (worst-case single-query turnaround over
     # the device link).
-    n_queries = 512 if platform != "cpu" else 20
+    n_queries = 256 if platform != "cpu" else 20
     t0 = time.perf_counter()
-    outs = [intersect_count(a, b) for _ in range(n_queries)]
+    outs = [query(a, b, idx[i % 1024]) for i in range(n_queries)]
     jax.block_until_ready(outs)
     elapsed = time.perf_counter() - t0
     qps = n_queries / elapsed
 
-    n_lat = 50 if platform != "cpu" else 5
+    n_lat = 30 if platform != "cpu" else 5
     lat_samples = []
-    for _ in range(n_lat):
+    for i in range(n_lat):
         t0 = time.perf_counter()
-        got = int(intersect_count(a, b))
+        int(query(a, b, idx[(997 + i) % 1024]))
         lat_samples.append(time.perf_counter() - t0)
     lat_ms = float(np.percentile(lat_samples, 50)) * 1000
 
-    # CPU single-node baseline: identical computation, resident in RAM,
-    # vectorized numpy (measured on a subset and scaled if slow).
+    # CPU single-node baseline: identical distinct-query computation,
+    # resident in RAM, vectorized numpy.
     host_a_full = np.asarray(a)
     host_b_full = np.asarray(b)
     reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
-        cpu_got = cpu_popcount_sum(np.bitwise_and(host_a_full, host_b_full))
+    for i in range(reps):
+        cpu_got = cpu_popcount_sum(np.bitwise_and(
+            host_a_full, np.roll(host_b_full, i + 1, axis=0)))
     cpu_elapsed = time.perf_counter() - t0
     cpu_qps = reps / cpu_elapsed
-    if cpu_got != got:
+    want = cpu_got  # last loop iteration used roll(b, reps)
+    got_dev = int(query(a, b, jnp.asarray(reps)))
+    if want != got_dev:
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "error": "tpu/cpu result mismatch"}))
         sys.exit(1)

@@ -12,6 +12,22 @@ import sys
 import time
 
 
+def _honor_jax_platforms_env():
+    """Re-assert the JAX_PLATFORMS env var. Site hooks (e.g. a
+    sitecustomize installing an accelerator plugin) may force a platform
+    via jax.config at interpreter start, silently overriding the operator's
+    env var; a server explicitly launched with JAX_PLATFORMS=cpu must run
+    on cpu."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
 DEFAULT_CONFIG = {
     "bind": "127.0.0.1:10101",
     "data-dir": "~/.pilosa_tpu",
@@ -247,7 +263,11 @@ def cmd_backup(args):
 
     from .server import Client
 
-    client = Client(args.host)
+    def make_client(url):
+        return Client(url, tls_skip_verify=args.tls_skip_verify,
+                      ca_cert=args.tls_ca)
+
+    client = make_client(args.host)
     schema = client.schema()
     indexes = [i for i in schema.get("indexes", [])
                if args.index is None or i["name"] == args.index]
@@ -258,40 +278,61 @@ def cmd_backup(args):
     # node so shards held only by peers are captured too (a single-node
     # backup of a cluster would otherwise be silently partial).
     clients = [client]
-    for node in client._request("GET", "/internal/nodes"):
+    for node in client.nodes():
         uri = node.get("uri")
         if uri and uri.rstrip("/") != client.base_url:
-            clients.append(Client(uri))
+            clients.append(make_client(uri))
 
     def add(tar, name, data):
         info = tarfile.TarInfo(name)
         info.size = len(data)
         tar.addfile(info, io.BytesIO(data))
 
+    # Write to a temp name and publish only on success so a refused or
+    # crashed backup never leaves a plausible-looking partial archive at
+    # --output (same temp+rename discipline as fragment snapshots).
+    tmp_out = args.output + ".partial"
     n_frags = 0
-    with tarfile.open(args.output, "w") as tar:
+    unreachable = []
+    with tarfile.open(tmp_out, "w") as tar:
         add(tar, "schema.json",
             json.dumps({"indexes": indexes}).encode())
         for idx in indexes:
             iname = idx["name"]
             seen = set()
             for c in clients:
+                # a node can fail at ANY of the three fetches; every
+                # failure routes through the same unreachable gate
                 try:
                     shards = c.index_shards(iname).get("shards", [])
-                except Exception:
-                    continue  # node down: replicas cover its shards
-                for shard in shards:
-                    frags = c.shard_fragments(
-                        iname, shard).get("fragments", [])
-                    for frag in frags:
-                        name = (f"{iname}/{frag['field']}/{frag['view']}"
-                                f"/{shard}")
-                        if name in seen:
-                            continue
-                        seen.add(name)
-                        add(tar, name, c.fragment_data(
-                            iname, frag["field"], frag["view"], shard))
-                        n_frags += 1
+                    for shard in shards:
+                        frags = c.shard_fragments(
+                            iname, shard).get("fragments", [])
+                        for frag in frags:
+                            name = (f"{iname}/{frag['field']}"
+                                    f"/{frag['view']}/{shard}")
+                            if name in seen:
+                                continue
+                            data = c.fragment_data(
+                                iname, frag["field"], frag["view"], shard)
+                            seen.add(name)
+                            add(tar, name, data)
+                            n_frags += 1
+                except Exception as e:
+                    unreachable.append(f"{c.base_url} ({e})")
+    if unreachable:
+        # An unreachable node may hold shards no replica covers; there is
+        # no way to verify coverage without it, so don't pretend the
+        # archive is complete (reference behavior: backups are node-exact).
+        print(f"warning: node(s) unreachable during backup: "
+              f"{sorted(set(unreachable))}; archive may be missing their "
+              f"exclusively-held shards", file=sys.stderr)
+        if not args.allow_partial:
+            os.unlink(tmp_out)
+            raise SystemExit(
+                "refusing to write a possibly-partial backup "
+                "(pass --allow-partial to accept)")
+    os.replace(tmp_out, args.output)
     print(f"backed up {len(indexes)} index(es), {n_frags} fragment(s) "
           f"to {args.output}")
     return 0
@@ -305,7 +346,8 @@ def cmd_restore(args):
 
     from .server import Client
 
-    client = Client(args.host)
+    client = Client(args.host, tls_skip_verify=args.tls_skip_verify,
+                    ca_cert=args.tls_ca)
     n_frags = 0
     with tarfile.open(args.input) as tar:
         schema_member = tar.getmember("schema.json")
@@ -397,6 +439,7 @@ def cmd_generate_config(args):
 
 
 def main(argv=None):
+    _honor_jax_platforms_env()
     parser = argparse.ArgumentParser(
         prog="pilosa_tpu", description="TPU-native distributed bitmap index")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -441,16 +484,27 @@ def main(argv=None):
     p.add_argument("file", help="CSV path or - for stdin")
     p.set_defaults(fn=cmd_import)
 
+    def add_tls_flags(p):
+        p.add_argument("--tls-skip-verify", action="store_true",
+                       help="accept any server certificate")
+        p.add_argument("--tls-ca", default=None,
+                       help="PEM CA bundle for https servers")
+
     p = sub.add_parser("backup", help="archive index data from a server")
     p.add_argument("--host", default="http://127.0.0.1:10101")
     p.add_argument("--index", default=None,
                    help="index to back up (default: all)")
     p.add_argument("--output", required=True, help="tar file to write")
+    p.add_argument("--allow-partial", action="store_true",
+                   help="write the archive even when some cluster nodes "
+                        "are unreachable")
+    add_tls_flags(p)
     p.set_defaults(fn=cmd_backup)
 
     p = sub.add_parser("restore", help="restore a backup tar into a server")
     p.add_argument("--host", default="http://127.0.0.1:10101")
     p.add_argument("--input", required=True, help="tar file to read")
+    add_tls_flags(p)
     p.set_defaults(fn=cmd_restore)
 
     p = sub.add_parser("export", help="export a field as CSV")

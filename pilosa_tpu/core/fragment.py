@@ -341,6 +341,8 @@ class Fragment:
         """Merge a serialized roaring blob of positions — the fastest ingest
         path (reference: importRoaring fragment.go:2255). Returns changed."""
         other, _, _ = deserialize(data, with_ops=True)
+        if os.environ.get("PILOSA_TPU_PARANOIA") == "1":
+            other.check()  # reject malformed foreign blobs loudly
         with self._lock:
             changed = merge_bitmaps(self.storage, other, clear=clear)
             if changed:
@@ -449,6 +451,10 @@ class Fragment:
     def _snapshot_locked(self):
         """Rewrite the file without the op log (reference:
         unprotectedWriteToFragment fragment.go:2347, temp+rename)."""
+        if os.environ.get("PILOSA_TPU_PARANOIA") == "1":
+            # paranoid-build analog (reference: roaring_paranoia.go):
+            # validate storage invariants before persisting them
+            self.storage.check()
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
             f.write(serialize(self.storage, flags=self.flags))

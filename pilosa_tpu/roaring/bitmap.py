@@ -10,7 +10,7 @@ import bisect
 
 import numpy as np
 
-from .containers import Container, popcount32
+from .containers import Container, container_check, popcount32
 
 CONTAINER_BITS = 1 << 16
 MAX_CONTAINER_KEY = (1 << 48) - 1  # reference: roaring/roaring.go:60
@@ -235,3 +235,24 @@ class Bitmap:
         b.containers = {k: c.clone() for k, c in self.containers.items()}
         b._keys = list(self._keys)
         return b
+
+    # -- invariants (reference: roaring_paranoia.go roaringParanoia tag,
+    #    Bitmap.Check roaring.go:1664, Container.check :3010) --------------
+
+    def check(self):
+        """Validate every structural invariant; raises AssertionError with
+        all violations. Enabled on hot paths by PILOSA_TPU_PARANOIA=1
+        (the reference's paranoid-build analog)."""
+        errors = []
+        if any(a >= b for a, b in zip(self._keys, self._keys[1:])):
+            errors.append("container keys not strictly increasing")
+        if len(self._keys) != len(self.containers) or \
+                set(self._keys) != set(self.containers):
+            errors.append("key list and container map disagree")
+        for key, c in self.containers.items():
+            errors.extend(
+                f"container {key}: {e}" for e in container_check(c))
+        if errors:
+            raise AssertionError("bitmap invariants violated: "
+                                 + "; ".join(errors))
+        return True

@@ -285,3 +285,42 @@ def words_to_values(words):
     from .. import native
 
     return native.extract_u16(words)
+
+
+def container_check(c):
+    """Invariant violations of one container as a list of strings
+    (reference: Container.check roaring.go:3010)."""
+    errors = []
+    if c.typ == TYPE_ARRAY:
+        if c.values is None:
+            return ["array container without values"]
+        if len(c.values) != c.n:
+            errors.append(f"n={c.n} but {len(c.values)} values")
+        if len(c.values) > 1 and not np.all(np.diff(
+                c.values.astype(np.int64)) > 0):
+            errors.append("array values not sorted unique")
+    elif c.typ == TYPE_BITMAP:
+        if c.words is None or len(c.words) != WORDS:
+            return ["bitmap container with wrong word count"]
+        actual = int(np.sum(popcount32(c.words)))
+        if actual != c.n:
+            errors.append(f"n={c.n} but {actual} bits set")
+    elif c.typ == TYPE_RUN:
+        runs = c.runs
+        if runs is None:
+            return ["run container without runs"]
+        last_end = -1
+        total = 0
+        for s, l in runs:
+            s, l = int(s), int(l)
+            if s <= last_end:
+                errors.append(f"run [{s},{l}] overlaps/unsorted")
+            if l < s:
+                errors.append(f"run [{s},{l}] inverted")
+            total += l - s + 1
+            last_end = l
+        if total != c.n:
+            errors.append(f"n={c.n} but runs cover {total}")
+    else:
+        errors.append(f"unknown type {c.typ}")
+    return errors

@@ -102,6 +102,10 @@ class PilosaHTTPServer:
                   self._set_coordinator),
             Route("GET", r"/metrics", self._get_metrics),
             Route("GET", r"/debug/vars", self._get_debug_vars),
+            Route("GET", r"/debug/pprof/goroutine", self._get_threads),
+            Route("POST", r"/debug/pprof/profile/start",
+                  self._profile_start),
+            Route("POST", r"/debug/pprof/profile/stop", self._profile_stop),
         ]
 
     # -- handlers ------------------------------------------------------------
@@ -323,6 +327,46 @@ class PilosaHTTPServer:
         return RawResponse(registry_of(self.stats).expvar_json().encode(),
                            "application/json")
 
+    # -- profiling (reference: /debug/pprof routes http/handler.go:280;
+    #    profile.cpu config server/config.go) --------------------------------
+
+    def _get_threads(self, req):
+        """Stack dump of every live thread (the goroutine-dump analog)."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            out.append(f"thread {names.get(ident, '?')} ({ident}):")
+            out.extend(l.rstrip() for l in traceback.format_stack(frame))
+            out.append("")
+        return RawResponse("\n".join(out).encode(), "text/plain")
+
+    _profiler_lock = threading.Lock()
+
+    def _profile_start(self, req):
+        """Begin a sampling CPU profile of ALL threads (cProfile is
+        per-thread and would only see the handler thread that started it;
+        a sampler over sys._current_frames covers the whole serving
+        path)."""
+        interval = float(self._q1(req, "interval", "0.01"))
+        with self._profiler_lock:
+            if getattr(self, "_profiler", None) is not None:
+                raise ApiError("profile already running")
+            self._profiler = _SamplingProfiler(interval).start()
+        return None
+
+    def _profile_stop(self, req):
+        """Stop profiling and return sampled frames, hottest first."""
+        with self._profiler_lock:
+            prof = getattr(self, "_profiler", None)
+            if prof is None:
+                raise ApiError("no profile running")
+            self._profiler = None
+        return RawResponse(prof.stop().encode(), "text/plain")
+
     # -- server lifecycle ----------------------------------------------------
 
     def start(self):
@@ -428,6 +472,63 @@ class PilosaHTTPServer:
             "http_request_seconds", _time.perf_counter() - t0,
             {"path": path, "method": handler.command,
              "status": str(status)})
+
+
+class _SamplingProfiler:
+    """Wall-clock stack sampler across every thread (py-spy style).
+    `self` = samples where the frame is the leaf; `cum` = samples where it
+    appears anywhere in a stack."""
+
+    def __init__(self, interval=0.01):
+        self.interval = max(interval, 0.001)
+        self.self_counts = {}
+        self.cum_counts = {}
+        self.n_samples = 0
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+    def _sample(self):
+        import sys
+
+        me = threading.get_ident()
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            self.n_samples += 1
+            leaf = True
+            seen = set()
+            while frame is not None:
+                code = frame.f_code
+                key = f"{code.co_filename}:{frame.f_lineno} {code.co_name}"
+                if leaf:
+                    self.self_counts[key] = self.self_counts.get(key, 0) + 1
+                    leaf = False
+                if key not in seen:  # count recursion once per stack
+                    seen.add(key)
+                    self.cum_counts[key] = self.cum_counts.get(key, 0) + 1
+                frame = frame.f_back
+
+    def _run(self):
+        while not self._stop_evt.wait(self.interval):
+            self._sample()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="pilosa-profiler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        self._thread.join(timeout=5)
+        lines = [f"samples: {self.n_samples} "
+                 f"(interval {self.interval * 1000:.0f}ms)",
+                 "", "self  cum   frame"]
+        ranked = sorted(self.self_counts.items(),
+                        key=lambda kv: -kv[1])[:50]
+        for key, n in ranked:
+            lines.append(f"{n:>5} {self.cum_counts.get(key, 0):>5} {key}")
+        return "\n".join(lines) + "\n"
 
 
 class Request:

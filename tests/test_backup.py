@@ -102,3 +102,39 @@ def test_backup_covers_whole_cluster(tmp_path):
         assert dst.client.query("bk", "Count(Row(f=1))")["results"] == [4]
     finally:
         dst.close()
+
+
+def test_backup_refuses_partial_without_flag(tmp_path):
+    """With a peer unreachable, backup must not leave an archive at
+    --output unless --allow-partial is given."""
+    from pilosa_tpu.cluster import Cluster, Node
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.http_server import PilosaHTTPServer
+
+    import pytest
+
+    holder = Holder(str(tmp_path / "data")).open()
+    # cluster of 2 where the peer address answers nothing
+    nodes = [Node(id="a", uri="http://127.0.0.1:1"),
+             Node(id="b", uri="http://127.0.0.1:9")]
+    srv = None
+    try:
+        holder.create_index("px")  # direct: DDL broadcast would need peer
+        cluster = Cluster(nodes=nodes, local_id="a", replica_n=1)
+        api = API(holder, cluster=cluster, client_factory=Client)
+        srv = PilosaHTTPServer(api, host="127.0.0.1", port=0).start()
+        nodes[0].uri = srv.address  # local node serves on the real port
+        tar_path = str(tmp_path / "p.tar")
+        with pytest.raises(SystemExit, match="partial"):
+            main(["backup", "--host", srv.address, "--output", tar_path])
+        assert not os.path.exists(tar_path)
+        assert not os.path.exists(tar_path + ".partial")
+        assert main(["backup", "--host", srv.address, "--output", tar_path,
+                     "--allow-partial"]) == 0
+        assert os.path.exists(tar_path)
+    finally:
+        if srv:
+            srv.stop()
+        holder.close()
