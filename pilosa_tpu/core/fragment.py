@@ -14,11 +14,14 @@ Durability model (reference: fragment.go:2311-2395, roaring op log):
   snapshotted (file rewritten via temp+rename, op log reset).
 """
 
+import itertools
 import os
 import hashlib
 import threading
 
 import numpy as np
+
+_fragment_uids = itertools.count(1)
 
 from ..roaring import (
     Bitmap,
@@ -82,9 +85,12 @@ class Fragment:
         self._lock = threading.RLock()
 
         # Device plane cache: rowID -> jax array; bumped generation
-        # invalidates derived stacks.
+        # invalidates derived stacks. uid is process-unique so caches keyed
+        # by (uid, generation) can never confuse a recreated fragment
+        # (same path, fresh counter) with its predecessor.
         self._row_cache = {}
         self.generation = 0
+        self.uid = next(_fragment_uids)
 
         # Block checksums cache (anti-entropy; reference fragment.checksums).
         self._checksums = {}

@@ -57,7 +57,10 @@ class Executor:
     wraps this with shard->node fan-out."""
 
     def __init__(self, holder):
+        from .stacked import StackedCountEvaluator
+
         self.holder = holder
+        self._stacked = StackedCountEvaluator()
 
     # ------------------------------------------------------------------ API
 
@@ -466,8 +469,14 @@ class Executor:
         if len(call.children) != 1:
             raise ExecError("Count() takes exactly one row query")
         self.validate_bitmap_call(idx, call.children[0])
+        shard_list = self._call_shards(idx, shards)
+        # Fast path: linearizable Row/set-op trees evaluate over ALL shards
+        # in one fused dispatch on generation-cached [S, W] stacks.
+        fast = self._stacked.try_count(idx, call.children[0], shard_list)
+        if fast is not None:
+            return fast
         counts = []
-        for shard in self._call_shards(idx, shards):
+        for shard in shard_list:
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
             if plane is not None:
                 counts.append(bitplane.popcount(plane))

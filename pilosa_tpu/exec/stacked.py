@@ -1,0 +1,218 @@
+"""Stacked Count fast path.
+
+The general executor evaluates a bitmap call tree shard by shard — correct
+for every call, but each shard costs several device dispatches. For the
+serving-critical shape — Count over a tree of Row leaves combined with
+Intersect/Union/Difference/Xor/Not (the north-star query,
+executor.go:1665/1790) — this module evaluates ALL shards in ONE fused XLA
+dispatch: each leaf row becomes a [shards, words] stacked plane resident on
+device, the tree becomes a single jitted elementwise+popcount+reduce
+program, and the per-query work is one dispatch and one scalar sync.
+
+Stacks are cached per (index, field, row, shard-set) and invalidated by the
+fragments' write-generation counters (fragment.generation — bumped by every
+mutation), so a stale stack can never serve a query. LRU-bounded: at
+SHARD_WIDTH=2^20 a 954-shard stack is ~120 MB of HBM, so only the hottest
+rows stay resident (the device analog of fragment.rowCache
+fragment.go:367).
+"""
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core.index import EXISTENCE_FIELD_NAME
+from ..core.view import VIEW_STANDARD
+from ..shardwidth import WORDS_PER_ROW
+
+# Device-byte budget for cached stacks; excess evicts least-recently-used.
+# (Entry size scales with shard count — ~120 MB per 954-shard stack — so a
+# count bound alone could pin several GB of HBM.)
+MAX_STACK_BYTES = 512 * 1024 * 1024
+# Compiled tree programs are tiny but unbounded shapes would accumulate.
+MAX_FNS = 128
+# Below this many shards the per-shard path's dispatch count is too small
+# to matter.
+MIN_SHARDS = 2
+
+_OPS = {"Intersect": "&", "Union": "|", "Difference": "-", "Xor": "^"}
+
+
+class StackedCountEvaluator:
+    def __init__(self):
+        self._stacks = OrderedDict()  # key -> (gens tuple, device stack)
+        self._stack_bytes = 0
+        self._fns = OrderedDict()     # tree signature -> jitted fn
+        self._lock = threading.Lock()
+
+    # -- tree analysis -------------------------------------------------------
+
+    def _leaf(self, idx, field_name, row_id, leaves):
+        field = idx.field(field_name)
+        if field is None or field.view(VIEW_STANDARD) is None:
+            return None
+        key = (field_name, int(row_id))
+        if key not in leaves:
+            leaves[key] = len(leaves)
+        return ("leaf", leaves[key])
+
+    def signature(self, idx, call, leaves):
+        """Tree signature with leaf slots, or None when the tree has any
+        shape the fast path doesn't cover (conditions, time ranges, Shift,
+        keys...). None means: use the general per-shard path."""
+        name = call.name
+        if name in ("Row", "Range"):
+            if call.has_conditions() or "from" in call.args \
+                    or "to" in call.args:
+                return None
+            field_name = call.field_arg()
+            if field_name is None:
+                return None
+            row_id = call.args.get(field_name)
+            if isinstance(row_id, bool):
+                row_id = int(row_id)
+            if not isinstance(row_id, int):
+                return None
+            return self._leaf(idx, field_name, row_id, leaves)
+        if name in _OPS and call.children:
+            subs = tuple(self.signature(idx, c, leaves)
+                         for c in call.children)
+            if any(s is None for s in subs):
+                return None
+            return (_OPS[name], subs)
+        if name == "Not" and len(call.children) == 1 \
+                and idx.options.track_existence \
+                and idx.field(EXISTENCE_FIELD_NAME) is not None:
+            child = self.signature(idx, call.children[0], leaves)
+            if child is None:
+                return None
+            exists = self._leaf(idx, EXISTENCE_FIELD_NAME, 0, leaves)
+            if exists is None:
+                return None
+            return ("-", (exists, child))
+        return None
+
+    # -- stacks --------------------------------------------------------------
+
+    def _fragment_gens(self, idx, field_name, shards):
+        """Cache-validation fingerprint: per-shard (fragment uid,
+        generation). The uid makes a recreated fragment (field dropped and
+        re-made at the same path) distinct from its predecessor even when
+        the generation counters collide. None when the field vanished
+        (concurrent DDL) — caller falls back to the general path."""
+        field = idx.field(field_name)
+        view = field.view(VIEW_STANDARD) if field is not None else None
+        if view is None:
+            return None
+        gens = []
+        for shard in shards:
+            frag = view.fragment(shard)
+            gens.append((-1, -1) if frag is None
+                        else (frag.uid, frag.generation))
+        return tuple(gens)
+
+    def _stack(self, idx, field_name, row_id, shards):
+        import jax.numpy as jnp
+
+        key = (idx.name, field_name, row_id, shards)
+        gens = self._fragment_gens(idx, field_name, shards)
+        if gens is None:
+            return None
+        with self._lock:
+            hit = self._stacks.get(key)
+            if hit is not None and hit[0] == gens:
+                self._stacks.move_to_end(key)
+                return hit[1]
+        field = idx.field(field_name)
+        view = field.view(VIEW_STANDARD) if field is not None else None
+        if view is None:
+            return None
+        rows = []
+        zeros = None
+        for shard in shards:
+            frag = view.fragment(shard)
+            plane = None if frag is None else frag.row_plane(row_id)
+            if plane is None:
+                if zeros is None:
+                    zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+                plane = zeros
+            rows.append(np.asarray(plane))
+        stack = jnp.asarray(np.stack(rows))
+        nbytes = len(shards) * WORDS_PER_ROW * 4
+        with self._lock:
+            old = self._stacks.pop(key, None)
+            if old is not None:
+                self._stack_bytes -= len(old[0]) * WORDS_PER_ROW * 4
+            self._stacks[key] = (gens, stack)
+            self._stack_bytes += nbytes
+            while self._stack_bytes > MAX_STACK_BYTES and len(self._stacks) > 1:
+                _, (egens, _) = self._stacks.popitem(last=False)
+                self._stack_bytes -= len(egens) * WORDS_PER_ROW * 4
+        return stack
+
+    # -- compiled tree evaluation -------------------------------------------
+
+    def _fn(self, sig, arity):
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            fn = self._fns.get((sig, arity))
+            if fn is not None:
+                self._fns.move_to_end((sig, arity))
+        if fn is None:
+            def ev(node, stacks):
+                if node[0] == "leaf":
+                    return stacks[node[1]]
+                op, subs = node
+                acc = ev(subs[0], stacks)
+                for s in subs[1:]:
+                    p = ev(s, stacks)
+                    if op == "&":
+                        acc = acc & p
+                    elif op == "|":
+                        acc = acc | p
+                    elif op == "^":
+                        acc = acc ^ p
+                    else:
+                        acc = acc & ~p
+                return acc
+
+            @jax.jit
+            def fn(*stacks):
+                # int32 accumulate matches the other count kernels (safe:
+                # a count never exceeds the <2^31 column universe served
+                # per node; see bench.py)
+                acc = ev(sig, stacks)
+                return jnp.sum(
+                    jax.lax.population_count(acc).astype(jnp.int32))
+
+            with self._lock:
+                self._fns[(sig, arity)] = fn
+                while len(self._fns) > MAX_FNS:
+                    self._fns.popitem(last=False)
+        return fn
+
+    # -- entry ---------------------------------------------------------------
+
+    def try_count(self, idx, call_child, shards):
+        """Count(call_child) over `shards` in one dispatch, or None when
+        the tree isn't coverable (caller falls back)."""
+        shards = tuple(shards)
+        if len(shards) < MIN_SHARDS:
+            return None
+        leaves = {}
+        sig = self.signature(idx, call_child, leaves)
+        if sig is None or not leaves:
+            return None
+        ordered = sorted(leaves.items(), key=lambda kv: kv[1])
+        stacks = [self._stack(idx, f, r, shards) for (f, r), _ in ordered]
+        if any(s is None for s in stacks):
+            return None  # concurrent DDL: fall back to the general path
+        return int(self._fn(sig, len(stacks))(*stacks))
+
+    def invalidate(self):
+        with self._lock:
+            self._stacks.clear()
+            self._stack_bytes = 0
