@@ -15,6 +15,13 @@ mutation), so a stale stack can never serve a query. LRU-bounded: at
 SHARD_WIDTH=2^20 a 954-shard stack is ~120 MB of HBM, so only the hottest
 rows stay resident (the device analog of fragment.rowCache
 fragment.go:367).
+
+On a multi-device host the stacks are placed sharded over a 1-D "shards"
+mesh (zero-padded to a device multiple — zero rows are count-neutral for
+every supported op chain), so the SAME jitted count program is GSPMD
+partitioned by XLA: per-device popcounts reduce over ICI instead of one
+chip doing all the work (SURVEY §2 parallelism: the shard axis is the one
+SPMD axis).
 """
 
 import threading
@@ -38,13 +45,35 @@ MIN_SHARDS = 2
 
 _OPS = {"Intersect": "&", "Union": "|", "Difference": "-", "Xor": "^"}
 
+_UNSET = object()
+
 
 class StackedCountEvaluator:
     def __init__(self):
-        self._stacks = OrderedDict()  # key -> (gens tuple, device stack)
+        self._stacks = OrderedDict()  # key -> (gens, device stack, nbytes)
         self._stack_bytes = 0
         self._fns = OrderedDict()     # tree signature -> jitted fn
         self._lock = threading.Lock()
+        self._sharding = _UNSET
+
+    def _stack_sharding(self):
+        """NamedSharding over all local devices (None on a single device),
+        resolved lazily so importing this module never touches the
+        backend."""
+        if self._sharding is _UNSET:
+            import jax
+
+            # local_devices: host-local numpy stacks can't be placed onto
+            # other processes' chips; cross-host scale-out is the cluster
+            # layer's job (shards_by_node), not this cache's.
+            devices = jax.local_devices()
+            if len(devices) < 2:
+                self._sharding = None
+            else:
+                mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
+                self._sharding = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("shards"))
+        return self._sharding
 
     # -- tree analysis -------------------------------------------------------
 
@@ -128,6 +157,8 @@ class StackedCountEvaluator:
         view = field.view(VIEW_STANDARD) if field is not None else None
         if view is None:
             return None
+        import jax
+
         rows = []
         zeros = None
         for shard in shards:
@@ -138,17 +169,28 @@ class StackedCountEvaluator:
                     zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
                 plane = zeros
             rows.append(np.asarray(plane))
-        stack = jnp.asarray(np.stack(rows))
-        nbytes = len(shards) * WORDS_PER_ROW * 4
+        sharding = self._stack_sharding()
+        if sharding is not None:
+            # zero-pad to a device multiple; zero rows are count-neutral
+            n_dev = len(sharding.device_set)
+            pad = (-len(rows)) % n_dev
+            if pad:
+                if zeros is None:
+                    zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+                rows.extend([zeros] * pad)
+            stack = jax.device_put(np.stack(rows), sharding)
+        else:
+            stack = jnp.asarray(np.stack(rows))
+        nbytes = len(rows) * WORDS_PER_ROW * 4
         with self._lock:
             old = self._stacks.pop(key, None)
             if old is not None:
-                self._stack_bytes -= len(old[0]) * WORDS_PER_ROW * 4
-            self._stacks[key] = (gens, stack)
+                self._stack_bytes -= old[2]
+            self._stacks[key] = (gens, stack, nbytes)
             self._stack_bytes += nbytes
             while self._stack_bytes > MAX_STACK_BYTES and len(self._stacks) > 1:
-                _, (egens, _) = self._stacks.popitem(last=False)
-                self._stack_bytes -= len(egens) * WORDS_PER_ROW * 4
+                _, evicted = self._stacks.popitem(last=False)
+                self._stack_bytes -= evicted[2]
         return stack
 
     # -- compiled tree evaluation -------------------------------------------

@@ -120,3 +120,18 @@ def test_missing_fragments_are_zero(setup):
     n = ex.execute("st", "Count(Row(f=1))")[0]
     assert ex.execute(
         "st", "Count(Union(Row(f=1), Row(empty=9)))")[0] == n
+
+
+def test_stacks_sharded_over_devices(setup):
+    """On a multi-device host the cached stacks must be mesh-sharded so
+    XLA partitions the count over devices."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >1 device")
+    holder, api = setup
+    ex = Executor(holder)
+    assert ex.execute("st", "Count(Row(f=1))")[0] > 0
+    (_, stack, _), = list(ex._stacked._stacks.values())
+    assert len(stack.sharding.device_set) == len(jax.devices())
+    assert stack.shape[0] % len(jax.devices()) == 0  # zero-padded
