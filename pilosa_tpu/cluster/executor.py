@@ -16,6 +16,7 @@ HBM; the local reduce happens inside fused XLA dispatches (exec.Executor),
 and only per-node partial results cross the DCN as JSON.
 """
 
+import os
 import threading
 
 from ..core.row import Row
@@ -30,6 +31,17 @@ class ClusterExecError(Exception):
 
 
 # ---------------------------------------------------------------- decoding
+
+def _internal_wire():
+    """Node-to-node encoding: "proto" (default) or "json". Unknown values
+    fail fast rather than silently selecting proto."""
+    wire = os.environ.get("PILOSA_TPU_INTERNAL_WIRE", "proto").lower()
+    if wire not in ("proto", "json"):
+        raise ClusterExecError(
+            f"PILOSA_TPU_INTERNAL_WIRE must be 'proto' or 'json', "
+            f"got {wire!r}")
+    return wire
+
 
 def result_from_json(d):
     """Decode one remote result by JSON shape (the reference decodes by
@@ -263,15 +275,34 @@ class ClusterExecutor:
                 else:
                     merged[0] = reduce_results(call, merged[0], result)
 
+        use_proto = _internal_wire() != "json"
+        pql = call_to_pql(call)  # invariant across nodes and retries
+
         def run_node(node, node_shards, tried=()):
             try:
                 if node.id == self.cluster.local_id:
                     result = self.local.execute_call(
                         idx, call, node_shards, self._remote_opt(opt))
+                elif use_proto:
+                    # protobuf data plane for node-to-node fan-out
+                    # (reference: remoteExec posts proto QueryRequests,
+                    # executor.go:2414 + http/client.go:268)
+                    results, err = self._client(node).query_proto(
+                        idx.name, pql, shards=node_shards, remote=True)
+                    if err:
+                        raise ClusterExecError(err)
+                    if not results:
+                        raise ClusterExecError(
+                            f"malformed proto response from {node.id}: "
+                            "no results and no error")
+                    r = results[0]
+                    # proto Rows decode to their wire dict; everything else
+                    # is already a result object
+                    result = result_from_json(r) if isinstance(r, dict) \
+                        else r
                 else:
                     resp = self._client(node).query(
-                        idx.name, call_to_pql(call), shards=node_shards,
-                        remote=True)
+                        idx.name, pql, shards=node_shards, remote=True)
                     result = result_from_json(resp["results"][0])
                 merge_in(result)
             except Exception as e:
