@@ -137,18 +137,63 @@ def _decode_result(m):
     raise ValueError(f"unknown QueryResult type {t}")
 
 
-def encode_query_response(results, err=None):
+# Attr type tags (reference: attr.go:27-30)
+_ATTR_STRING, _ATTR_INT, _ATTR_BOOL, _ATTR_FLOAT = 1, 2, 3, 4
+
+
+def _encode_attrs(attrs, slot_adder):
+    for key, value in sorted(attrs.items()):
+        a = slot_adder()
+        a.Key = key
+        if isinstance(value, bool):
+            a.Type, a.BoolValue = _ATTR_BOOL, value
+        elif isinstance(value, int):
+            a.Type, a.IntValue = _ATTR_INT, value
+        elif isinstance(value, float):
+            a.Type, a.FloatValue = _ATTR_FLOAT, value
+        else:
+            a.Type, a.StringValue = _ATTR_STRING, str(value)
+
+
+def _decode_attrs(pb_attrs):
+    out = {}
+    for a in pb_attrs:
+        if a.Type == _ATTR_BOOL:
+            out[a.Key] = a.BoolValue
+        elif a.Type == _ATTR_INT:
+            out[a.Key] = a.IntValue
+        elif a.Type == _ATTR_FLOAT:
+            out[a.Key] = a.FloatValue
+        else:
+            out[a.Key] = a.StringValue
+    return out
+
+
+def encode_query_response(results, err=None, column_attr_sets=None):
     m = pb.QueryResponse()
     if err:
         m.Err = str(err)
     for r in results or []:
         _encode_result(r, m.Results.add())
+    for cas in column_attr_sets or []:
+        slot = m.ColumnAttrSets.add()
+        slot.ID = cas["id"]
+        _encode_attrs(cas.get("attrs") or {}, slot.Attrs.add)
     return m.SerializeToString()
 
 
 def decode_query_response(data):
     """-> (results list, err string or None). Row results decode to the
     JSON-ish dict shape (columns/keys) since the wire Row has no segment
-    structure."""
+    structure. Use decode_query_response_full for column attr sets."""
+    results, err, _ = decode_query_response_full(data)
+    return results, err
+
+
+def decode_query_response_full(data):
+    """-> (results, err, column_attr_sets)."""
     m = pb.QueryResponse.FromString(data)
-    return [_decode_result(r) for r in m.Results], (m.Err or None)
+    attr_sets = [{"id": c.ID, "attrs": _decode_attrs(c.Attrs)}
+                 for c in m.ColumnAttrSets]
+    return ([_decode_result(r) for r in m.Results], m.Err or None,
+            attr_sets)

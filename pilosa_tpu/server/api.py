@@ -156,6 +156,27 @@ class API:
         self._log_slow_query(index_name, pql, time.monotonic() - t0)
         return results
 
+    def column_attr_sets(self, index_name, results):
+        """Column attr sets for every Row result's columns (reference:
+        QueryResponse.ColumnAttrSets populated when the request asks for
+        columnAttrs — api.Query/readColumnAttrSets). Only columns that
+        actually have attrs appear."""
+        from ..core.row import Row
+
+        idx = self.holder.index(index_name)
+        if idx is None or idx.column_attr_store is None:
+            return []
+        cols = set()
+        for r in results:
+            if isinstance(r, Row):
+                cols.update(int(c) for c in r.columns())
+        out = []
+        for c in sorted(cols):
+            attrs = idx.column_attr_store.attrs(c)
+            if attrs:
+                out.append({"id": c, "attrs": attrs})
+        return out
+
     def _log_slow_query(self, index_name, pql, elapsed):
         """Slow-query log (reference: LongQueryTime api.go:1157)."""
         if (self.long_query_time is not None

@@ -162,12 +162,17 @@ class PilosaHTTPServer:
             from .. import encoding
 
             q = encoding.decode_query_request(req.body)
-            options = ExecOptions(remote=True) if q["remote"] else None
+            options = ExecOptions(remote=q["remote"],
+                                  column_attrs=q["column_attrs"])
             try:
                 results = self.api.query(
                     req.params["index"], q["query"], shards=q["shards"],
                     options=options)
-                body = encoding.encode_query_response(results)
+                attr_sets = self.api.column_attr_sets(
+                    req.params["index"], results) \
+                    if q["column_attrs"] else None
+                body = encoding.encode_query_response(
+                    results, column_attr_sets=attr_sets)
             except ApiError as e:
                 body = encoding.encode_query_response([], err=str(e))
             return RawResponse(body, encoding.CONTENT_TYPE_PROTOBUF)
@@ -176,12 +181,19 @@ class PilosaHTTPServer:
         shards = None
         if "shards" in req.query:
             shards = [int(s) for s in req.query["shards"][0].split(",") if s]
-        options = None
-        if req.query.get("remote", ["false"])[0] == "true":
-            options = ExecOptions(remote=True)
+        column_attrs = \
+            req.query.get("columnAttrs", ["false"])[0] == "true"
+        options = ExecOptions(
+            remote=req.query.get("remote", ["false"])[0] == "true",
+            column_attrs=column_attrs)
         results = self.api.query(
             req.params["index"], pql, shards=shards, options=options)
-        return {"results": [result_to_json(r) for r in results]}
+        out = {"results": [result_to_json(r) for r in results]}
+        if column_attrs:
+            # reference: QueryResponse "columnAttrs" JSON field
+            out["columnAttrs"] = self.api.column_attr_sets(
+                req.params["index"], results)
+        return out
 
     def _post_import(self, req):
         body = req.json()

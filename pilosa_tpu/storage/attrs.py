@@ -115,6 +115,23 @@ class SqliteAttrStore(AttrStore):
             self._cache[id] = attrs
         return dict(attrs)
 
+    def attrs_many(self, ids):
+        """{id: attrs} for ids that HAVE attrs — one batched SELECT per 500
+        ids instead of a query per column (columnAttrs response path)."""
+        out = {}
+        ids = [int(i) for i in ids]
+        with self._lock:
+            for i in range(0, len(ids), 500):
+                chunk = ids[i:i + 500]
+                marks = ",".join("?" * len(chunk))
+                for id_, data in self._db.execute(
+                        f"SELECT id, data FROM attrs WHERE id IN ({marks})",
+                        chunk):
+                    attrs = json.loads(data)
+                    if attrs:
+                        out[int(id_)] = attrs
+        return out
+
     def set_attrs(self, id, attrs):
         _validate_attrs(attrs)
         id = int(id)
@@ -159,6 +176,11 @@ class MemAttrStore(AttrStore):
     def attrs(self, id):
         with self._lock:
             return dict(self._data.get(int(id), {}))
+
+    def attrs_many(self, ids):
+        with self._lock:
+            return {int(i): dict(self._data[int(i)]) for i in ids
+                    if self._data.get(int(i))}
 
     def set_attrs(self, id, attrs):
         _validate_attrs(attrs)

@@ -82,3 +82,38 @@ def test_proto_data_plane_live(tmp_path):
         assert err and "nope" in err
     finally:
         h.close()
+
+
+def test_column_attr_sets_roundtrip_and_live(tmp_path):
+    """columnAttrs=true attaches attr sets on both wire encodings
+    (reference: QueryResponse.ColumnAttrSets api.go:135)."""
+    from pilosa_tpu.encoding.serializer import (
+        decode_query_response_full, encode_query_response)
+
+    blob = encode_query_response(
+        [7], column_attr_sets=[
+            {"id": 3, "attrs": {"name": "x", "n": 5, "ok": True,
+                                "w": 1.5}}])
+    results, err, attr_sets = decode_query_response_full(blob)
+    assert results == [7] and err is None
+    assert attr_sets == [
+        {"id": 3, "attrs": {"name": "x", "n": 5, "ok": True, "w": 1.5}}]
+
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path))
+    try:
+        h.client.create_index("ca")
+        h.client.create_field("ca", "f")
+        h.client.query("ca", "Set(1, f=10) Set(2, f=10)")
+        h.client.query("ca", 'SetColumnAttrs(1, city="nyc")')
+        out = h.client._request(
+            "POST", "/index/ca/query?columnAttrs=true", b"Row(f=10)",
+            content_type="text/plain")
+        assert out["columnAttrs"] == [
+            {"id": 1, "attrs": {"city": "nyc"}}]
+        # without the flag the field is absent
+        out = h.client.query("ca", "Row(f=10)")
+        assert "columnAttrs" not in out
+    finally:
+        h.close()
