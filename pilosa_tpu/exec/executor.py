@@ -57,10 +57,10 @@ class Executor:
     wraps this with shard->node fan-out."""
 
     def __init__(self, holder):
-        from .stacked import StackedCountEvaluator
+        from .stacked import StackedEvaluator
 
         self.holder = holder
-        self._stacked = StackedCountEvaluator()
+        self._stacked = StackedEvaluator()
 
     # ------------------------------------------------------------------ API
 
@@ -158,15 +158,22 @@ class Executor:
             self.validate_bitmap_call(idx, child)
 
     def _exec_bitmap_call(self, idx, call, shards, opt):
-        import jax.numpy as jnp
+        import jax
 
         self.validate_bitmap_call(idx, call)
-        row = Row()
+        # Dispatch every shard's plane chain asynchronously, then fetch all
+        # result planes in ONE device->host transfer (the per-shard chains
+        # themselves never sync; see module docstring).
+        planes = []
         for shard in self._call_shards(idx, shards):
             plane = self.bitmap_call_shard(idx, call, shard)
-            if plane is None:
-                continue
-            host = np.asarray(plane)
+            if plane is not None:
+                planes.append((shard, plane))
+        row = Row()
+        if not planes:
+            return row
+        hosts = jax.device_get([p for _, p in planes])
+        for (shard, _), host in zip(planes, hosts):
             if host.any():
                 row.segments[shard] = host
         return row
@@ -482,7 +489,12 @@ class Executor:
                 counts.append(bitplane.popcount(plane))
         if not counts:
             return 0
-        return int(jnp.sum(jnp.stack(counts)))
+        # Host int sum: per-shard counts fit int32 (<= 2^20) but the total
+        # can exceed 2^31 past 2048 shards.
+        import jax
+
+        return int(np.sum(np.asarray(
+            jax.device_get(jnp.stack(counts)), dtype=np.int64)))
 
     def _sum_filter_planes(self, idx, call, shard):
         """Returns (has_filter, plane). has_filter with plane None means the
@@ -499,6 +511,13 @@ class Executor:
             field_name = call.field_arg()
         return self._bsi_meta(idx, field_name)
 
+    def _agg_filter_call(self, idx, call):
+        """The optional filter child of an aggregate call, validated."""
+        if call.children:
+            self.validate_bitmap_call(idx, call.children[0])
+            return call.children[0]
+        return None
+
     def _exec_sum(self, idx, call, shards, opt):
         """(reference: executeSum executor.go:331 + fragment.sum)"""
         from ..ops import bsi as bsi_ops
@@ -507,8 +526,16 @@ class Executor:
         field = self._agg_field(idx, call)
         opts = field.options
         depth = opts.bit_depth
+        shard_list = self._call_shards(idx, shards)
+        # Fast path: one fused dispatch over stacked BSI planes for all
+        # shards (falls back when the filter tree isn't stack-coverable).
+        fast = self._stacked.try_sum(
+            idx, field, self._agg_filter_call(idx, call), shard_list)
+        if fast is not None:
+            total, count = fast
+            return ValCount(total + opts.base * count, count)
         per_shard = []
-        for shard in self._call_shards(idx, shards):
+        for shard in shard_list:
             data = self._bsi_planes(field, shard)
             if data is None:
                 continue
@@ -573,17 +600,29 @@ class Executor:
         return ValCount(sign_mult * mag + field.options.base, count)
 
     def _exec_min(self, idx, call, shards, opt):
-        field = self._agg_field(idx, call)
-        out = ValCount()
-        for shard in self._call_shards(idx, shards):
-            out = out.smaller(self._minmax_shard(field, idx, call, shard, False))
-        return out
+        return self._exec_minmax(idx, call, shards, is_max=False)
 
     def _exec_max(self, idx, call, shards, opt):
+        return self._exec_minmax(idx, call, shards, is_max=True)
+
+    def _exec_minmax(self, idx, call, shards, is_max):
         field = self._agg_field(idx, call)
+        shard_list = self._call_shards(idx, shards)
+        # Fast path: the narrowing bit-plane walk runs ONCE over stacked
+        # [D, S, W] planes (globally — identical result to the per-shard
+        # merge) instead of once per shard.
+        fast = self._stacked.try_minmax(
+            idx, field, self._agg_filter_call(idx, call), shard_list,
+            is_max)
+        if fast is not None:
+            mag, count = fast
+            if mag is None:
+                return ValCount()
+            return ValCount(mag + field.options.base, count)
         out = ValCount()
-        for shard in self._call_shards(idx, shards):
-            out = out.larger(self._minmax_shard(field, idx, call, shard, True))
+        for shard in shard_list:
+            vc = self._minmax_shard(field, idx, call, shard, is_max)
+            out = out.larger(vc) if is_max else out.smaller(vc)
         return out
 
     def _set_field(self, idx, call):
@@ -644,13 +683,34 @@ class Executor:
         The reference approximates with per-fragment rank caches + heap
         merge (executor.go:930, fragment.top fragment.go:1570); here the
         cache bounds which row planes get stacked, then exact counts come
-        from one fused popcount dispatch. Cache-less fields fall back to an
-        exact full-row scan (a superset of reference behavior)."""
+        from fused popcount dispatches (O(1) in shards on the stacked
+        path). Cache-less fields fall back to an exact full-row scan (a
+        superset of reference behavior).
+
+        threshold / tanimotoThreshold follow executor.go:947-995 +
+        fragment.top fragment.go:1570-1700: threshold drops rows whose
+        (filtered) count is below it; tanimotoThreshold T (1-100, requires
+        a source row) keeps rows where ceil(100·|row ∩ src| /
+        (|row| + |src| - |row ∩ src|)) > T."""
+        import math
+
         field = self._set_field(idx, call)
+        if field.type == FIELD_TYPE_INT:
+            raise ExecError(
+                f'cannot compute TopN() on integer field: "{field.name}"')
+        if len(call.children) > 1:
+            raise ExecError("TopN() can only have one input bitmap")
         if call.children:
             self.validate_bitmap_call(idx, call.children[0])
         n = call.args.get("n")
         ids = call.args.get("ids")
+        threshold = int(call.args.get("threshold") or 1)
+        tanimoto = int(call.args.get("tanimotoThreshold") or 0)
+        if tanimoto > 100 or tanimoto < 0:
+            raise ExecError("Tanimoto Threshold is from 1 to 100 only")
+        if tanimoto > 0 and not call.children:
+            raise ExecError(
+                "TopN(): tanimotoThreshold requires a source row query")
         counts = self._row_counts(idx, field, call, shards,
                                   restrict_ids=ids, use_cache=ids is None)
         # row-attribute filter (reference: attrName/attrValues
@@ -664,7 +724,28 @@ class Executor:
                 r: c for r, c in counts.items()
                 if field.row_attr_store.attrs(r).get(attr_name) in attr_values
             }
-        pairs = [Pair(row_id, cnt) for row_id, cnt in counts.items() if cnt > 0]
+        src = call.children[0] if call.children else None
+        # tanimoto needs each row's UNFILTERED cardinality and the source
+        # row's count; both come from host container cardinalities / the
+        # count fast path — no extra per-shard device work.
+        if tanimoto > 0 and src is not None:
+            shard_list = self._call_shards(idx, shards)
+            plain = self._plain_row_counts(idx, field, counts, shard_list)
+            src_count = self._count_of(idx, src, shard_list)
+            kept = {}
+            for row_id, cnt in counts.items():
+                if cnt <= 0:
+                    continue
+                denom = plain[row_id] + src_count - cnt
+                coeff = math.ceil(cnt * 100 / denom) if denom else 100
+                if coeff > tanimoto:
+                    kept[row_id] = cnt
+            counts = kept
+        # threshold and tanimoto are either/or (fragment.top:1610-1620).
+        min_count = 1 if (tanimoto > 0 and src is not None) \
+            else max(threshold, 1)
+        pairs = [Pair(row_id, cnt) for row_id, cnt in counts.items()
+                 if cnt >= min_count]
         pairs.sort(key=lambda p: (-p.count, p.id))
         # remote shards return untrimmed pairs so the coordinator's merge
         # stays exact (reference: executeTopN trims only when !opt.Remote)
@@ -672,34 +753,112 @@ class Executor:
             pairs = pairs[:int(n)]
         return pairs
 
+    def _plain_row_counts(self, idx, field, row_ids, shard_list):
+        """row -> UNFILTERED global cardinality, from host container
+        cardinalities (no device work; reference: fragment.rowCount)."""
+        totals = {int(r): 0 for r in row_ids}
+        view = field.view(VIEW_STANDARD)
+        if view is None:
+            return totals
+        for shard in shard_list:
+            frag = view.fragment(shard)
+            if frag is None:
+                continue
+            for r in totals:
+                totals[r] += frag.row_count(r)
+        return totals
+
+    def _count_of(self, idx, call, shard_list):
+        """Count of a bitmap call over shards (stacked fast path, else
+        per-shard popcount sum)."""
+        from ..ops import bitplane
+
+        fast = self._stacked.try_count(idx, call, shard_list)
+        if fast is not None:
+            return fast
+        total = 0
+        for shard in shard_list:
+            plane = self.bitmap_call_shard(idx, call, shard)
+            if plane is not None:
+                total += int(bitplane.popcount(plane))
+        return total
+
+    def _candidate_rows(self, field, shard_list, restrict_ids, use_cache,
+                        view_name):
+        """Global candidate row set: union over fragments of their TopN
+        cache ids (when populated) or all present rows."""
+        view = field.view(view_name)
+        if view is None:
+            return []
+        rows = set()
+        for shard in shard_list:
+            frag = view.fragment(shard)
+            if frag is None:
+                continue
+            if use_cache and frag.cache is not None and len(frag.cache):
+                rows.update(frag.cache.ids())
+            else:
+                rows.update(frag.row_ids())
+        if restrict_ids is not None:
+            wanted = {int(r) for r in restrict_ids}
+            rows &= wanted
+        return sorted(rows)
+
     def _row_counts(self, idx, field, call, shards, restrict_ids=None,
                     view_name=VIEW_STANDARD, use_cache=False):
         """row -> total count across shards, optionally intersected with the
         call's first child as filter. With use_cache, candidate rows come
         from the fragment's TopN cache when one is populated (the
-        reference's approximation: only cached rows compete)."""
+        reference's approximation: only cached rows compete).
+
+        Fast path: candidate rows stack into [R, S, W] chunks and ALL
+        shards count in O(rows/chunk) fused dispatches — dispatch count
+        independent of the shard count (vs. the reference's per-shard
+        fragment.top scans). Falls back per-shard when the filter tree
+        isn't stack-coverable (conditions, time ranges, ...)."""
         from ..ops import bitplane
         import jax.numpy as jnp
 
+        shard_list = self._call_shards(idx, shards)
+        filter_call = call.children[0] \
+            if (call is not None and call.children) else None
+
+        from .stacked import MIN_SHARDS
+
+        if len(shard_list) >= MIN_SHARDS:
+            covered, filt = self._stacked.filter_stack(
+                idx, filter_call, tuple(shard_list))
+            if covered:
+                candidates = self._candidate_rows(
+                    field, shard_list, restrict_ids, use_cache, view_name)
+                totals = self._stacked.row_counts(
+                    idx, field.name, candidates, filt, shard_list,
+                    view_name)
+                if totals is not None:
+                    if restrict_ids is not None:
+                        for r in restrict_ids:
+                            totals.setdefault(int(r), 0)
+                    return totals
+
+        # Fallback: per-shard chains, but over the SAME global candidate
+        # set as the fast path (union across fragments), so both paths
+        # return identical counts for identical data.
+        candidates = self._candidate_rows(
+            field, shard_list, restrict_ids, use_cache, view_name)
         totals = {}
         pending = []  # (row_ids_chunk, device_counts)
-        for shard in self._call_shards(idx, shards):
+        for shard in shard_list:
             view = field.view(view_name)
             frag = view.fragment(shard) if view else None
             if frag is None:
                 continue
             filt = None
-            if call is not None and call.children:
-                filt = self.bitmap_call_shard(idx, call.children[0], shard)
+            if filter_call is not None:
+                filt = self.bitmap_call_shard(idx, filter_call, shard)
                 if filt is None:
                     continue  # empty filter -> zero counts in this shard
-            if use_cache and frag.cache is not None and len(frag.cache):
-                row_ids = frag.cache.ids()
-            else:
-                row_ids = frag.row_ids()
-            if restrict_ids is not None:
-                wanted = {int(r) for r in restrict_ids}
-                row_ids = [r for r in row_ids if r in wanted]
+            present = set(frag.row_ids())
+            row_ids = [r for r in candidates if r in present]
             for i in range(0, len(row_ids), _TOPN_STACK_CHUNK):
                 chunk = row_ids[i:i + _TOPN_STACK_CHUNK]
                 stack = jnp.stack([frag.row_device(r) for r in chunk])
@@ -774,6 +933,85 @@ class Executor:
             for child in call.children
         ]
 
+        totals = self._group_by_stacked(
+            idx, fields, child_rows, filter_call, shard_list)
+        if totals is None:
+            totals = self._group_by_per_shard(
+                idx, fields, child_rows, filter_call, shard_list)
+
+        out = [
+            GroupCount(
+                [FieldRow(f.name, rid) for f, rid in zip(fields, group)],
+                cnt)
+            for group, cnt in sorted(totals.items())
+        ]
+        if limit is not None and not opt.remote:
+            out = out[:int(limit)]
+        return out
+
+    def _group_by_stacked(self, idx, fields, child_rows, filter_call,
+                          shard_list):
+        """Cross-product counts over stacked shard planes: outer levels
+        walk row combinations as [S, W] device intersections, the innermost
+        level batch-counts all its rows per combination prefix — dispatch
+        count is O(combinations · rows/chunk), independent of the shard
+        count (vs. the reference's per-(shard × combination) scans,
+        executor.go:1238). Returns None to fall back (too few shards, or a
+        filter the stacked path can't express)."""
+        from .stacked import MIN_SHARDS
+
+        if len(shard_list) < MIN_SHARDS:
+            return None
+        shards = tuple(shard_list)
+        covered, filt = self._stacked.filter_stack(idx, filter_call, shards)
+        if not covered:
+            return None
+        totals = {}
+        inner_field = fields[-1]
+        inner_rows = child_rows[-1]
+
+        chunk_size = self._stacked.row_chunk_size(shards)
+
+        def recurse(level, plane, prefix):
+            """plane: accumulated [S, W] restriction (None = everything).
+            Returns False to abort (stack construction failed; caller
+            falls back to the per-shard path)."""
+            if level == len(fields) - 1:
+                counts = self._stacked.row_counts(
+                    idx, inner_field.name, inner_rows, plane, shards)
+                if counts is None:
+                    return False
+                for r, c in counts.items():
+                    if c > 0:
+                        key = prefix + (r,)
+                        totals[key] = totals.get(key, 0) + c
+                return True
+            # Outer-level row planes come from the rows pool in chunks (not
+            # the leaf pool: a wide outer field must not evict the hot
+            # Count/Sum serving stacks), sliced per combination.
+            rows = child_rows[level]
+            for i in range(0, len(rows), chunk_size):
+                chunk = tuple(rows[i:i + chunk_size])
+                stack = self._stacked.rows_stack(
+                    idx, fields[level].name, chunk, shards)
+                if stack is None:
+                    return False
+                for j, row_id in enumerate(chunk):
+                    combined = stack[j] if plane is None \
+                        else plane & stack[j]
+                    if not recurse(level + 1, combined, prefix + (row_id,)):
+                        return False
+            return True
+
+        if not recurse(0, filt, ()):
+            return None
+        return totals
+
+    def _group_by_per_shard(self, idx, fields, child_rows, filter_call,
+                            shard_list):
+        from ..ops import bitplane
+        import jax.numpy as jnp
+
         totals = {}
         for shard in shard_list:
             frag_rows = []
@@ -815,16 +1053,7 @@ class Executor:
                 for group, c in zip(groups, host):
                     if int(c) > 0:
                         totals[group] = totals.get(group, 0) + int(c)
-
-        out = [
-            GroupCount(
-                [FieldRow(f.name, rid) for f, rid in zip(fields, group)],
-                cnt)
-            for group, cnt in sorted(totals.items())
-        ]
-        if limit is not None and not opt.remote:
-            out = out[:int(limit)]
-        return out
+        return totals
 
     # -------------------------------------------------------------- Options
 

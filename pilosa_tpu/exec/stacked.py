@@ -1,27 +1,32 @@
-"""Stacked Count fast path.
+"""Stacked serving fast paths: whole-index evaluation in O(1) dispatches.
 
-The general executor evaluates a bitmap call tree shard by shard — correct
-for every call, but each shard costs several device dispatches. For the
-serving-critical shape — Count over a tree of Row leaves combined with
-Intersect/Union/Difference/Xor/Not (the north-star query,
-executor.go:1665/1790) — this module evaluates ALL shards in ONE fused XLA
-dispatch: each leaf row becomes a [shards, words] stacked plane resident on
-device, the tree becomes a single jitted elementwise+popcount+reduce
-program, and the per-query work is one dispatch and one scalar sync.
+The general executor evaluates call trees shard by shard — correct for
+every call, but each shard costs device dispatches. For the serving-critical
+calls — Count, TopN, Sum, Min, Max, GroupBy (executor.go:930,1790,331,1098)
+— this module evaluates ALL shards in a constant number of fused XLA
+dispatches: fragment rows become [shards, words] stacked planes resident on
+device, call trees become jitted elementwise+popcount+reduce programs, and
+per-query work is a handful of dispatches and ONE host sync, independent of
+the shard count.
 
-Stacks are cached per (index, field, row, shard-set) and invalidated by the
-fragments' write-generation counters (fragment.generation — bumped by every
-mutation), so a stale stack can never serve a query. LRU-bounded: at
+Stacks are cached per (kind, index, field, rows, shard-set) and invalidated
+by the fragments' write-generation counters (fragment.generation — bumped by
+every mutation), so a stale stack can never serve a query. LRU-bounded: at
 SHARD_WIDTH=2^20 a 954-shard stack is ~120 MB of HBM, so only the hottest
-rows stay resident (the device analog of fragment.rowCache
-fragment.go:367).
+rows stay resident (the device analog of fragment.rowCache fragment.go:367).
 
 On a multi-device host the stacks are placed sharded over a 1-D "shards"
 mesh (zero-padded to a device multiple — zero rows are count-neutral for
-every supported op chain), so the SAME jitted count program is GSPMD
-partitioned by XLA: per-device popcounts reduce over ICI instead of one
-chip doing all the work (SURVEY §2 parallelism: the shard axis is the one
-SPMD axis).
+every supported op), so the SAME jitted programs are GSPMD partitioned by
+XLA: per-device popcounts reduce over ICI instead of one chip doing all the
+work (SURVEY §2 parallelism: the shard axis is the one SPMD axis).
+
+Overflow discipline: per-(row,shard) popcounts fit int32 (≤ 2^20), but
+totals over shards can exceed 2^31 (a >2048-shard index). TPUs run JAX with
+x64 disabled, so instead of int64 accumulators every cross-shard reduce
+returns a (hi, lo) int32 pair — hi = Σ(count >> 16), lo = Σ(count & 0xffff)
+— combined on host as exact Python ints. Safe to 2^15 shards (32768 shards
+≈ 34 trillion columns per node).
 """
 
 import threading
@@ -29,6 +34,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
 from ..core.index import EXISTENCE_FIELD_NAME
 from ..core.view import VIEW_STANDARD
 from ..shardwidth import WORDS_PER_ROW
@@ -37,24 +43,41 @@ from ..shardwidth import WORDS_PER_ROW
 # (Entry size scales with shard count — ~120 MB per 954-shard stack — so a
 # count bound alone could pin several GB of HBM.)
 MAX_STACK_BYTES = 512 * 1024 * 1024
+# Separate budget for TopN/GroupBy row-chunk stacks ([rows, shards, words]
+# keyed by the exact candidate tuple): they are large and churn with any
+# candidate-set change, so they must not be able to evict the long-lived
+# leaf/BSI stacks the Count/Sum serving paths depend on.
+MAX_ROWS_STACK_BYTES = 256 * 1024 * 1024
 # Compiled tree programs are tiny but unbounded shapes would accumulate.
 MAX_FNS = 128
 # Below this many shards the per-shard path's dispatch count is too small
 # to matter.
 MIN_SHARDS = 2
+# Transient row-chunk stacks ([rows, shards, words]) are built at most this
+# large, so TopN/GroupBy dispatch count is O(rows/chunk) — independent of
+# the shard count.
+CHUNK_BYTES = 128 * 1024 * 1024
 
 _OPS = {"Intersect": "&", "Union": "|", "Difference": "-", "Xor": "^"}
 
 _UNSET = object()
 
+from ..ops import bitplane  # noqa: E402
+from ..ops.bitplane import combine_hi_lo  # noqa: E402  (canonical helper)
 
-class StackedCountEvaluator:
+
+class StackedEvaluator:
     def __init__(self):
-        self._stacks = OrderedDict()  # key -> (gens, device stack, nbytes)
+        self._stacks = OrderedDict()  # key -> (gens, device arrays, nbytes)
         self._stack_bytes = 0
-        self._fns = OrderedDict()     # tree signature -> jitted fn
+        self._rows_stacks = OrderedDict()  # row-chunk pool (own budget)
+        self._rows_stack_bytes = 0
+        self._fns = OrderedDict()     # kernel signature -> jitted fn
         self._lock = threading.Lock()
         self._sharding = _UNSET
+        # Kernel-dispatch counter: tests assert serving dispatch counts are
+        # independent of the shard count.
+        self.dispatches = 0
 
     def _stack_sharding(self):
         """NamedSharding over all local devices (None on a single device),
@@ -74,6 +97,30 @@ class StackedCountEvaluator:
                 self._sharding = jax.sharding.NamedSharding(
                     mesh, jax.sharding.PartitionSpec("shards"))
         return self._sharding
+
+    def _n_pad_devices(self):
+        sharding = self._stack_sharding()
+        return 1 if sharding is None else len(sharding.device_set)
+
+    def _padded_len(self, shards):
+        """Shard-axis length zero-padded to a device multiple. Load-bearing
+        agreement: filter [S_pad, W] and rows [R, S_pad, W] stacks must use
+        the SAME padding or their elementwise combine misaligns."""
+        n_dev = self._n_pad_devices()
+        return ((len(shards) + n_dev - 1) // n_dev) * n_dev
+
+    def _place(self, host_stack, shard_axis):
+        """Upload a host stack, sharded over the device mesh along
+        `shard_axis` (already zero-padded by the caller)."""
+        import jax
+
+        sharding = self._stack_sharding()
+        if sharding is None:
+            return jax.device_put(host_stack)
+        spec = [None] * host_stack.ndim
+        spec[shard_axis] = "shards"
+        return jax.device_put(host_stack, jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec(*spec)))
 
     # -- tree analysis -------------------------------------------------------
 
@@ -122,16 +169,17 @@ class StackedCountEvaluator:
             return ("-", (exists, child))
         return None
 
-    # -- stacks --------------------------------------------------------------
+    # -- stack cache ---------------------------------------------------------
 
-    def _fragment_gens(self, idx, field_name, shards):
+    def _fragment_gens(self, idx, field_name, shards,
+                       view_name=VIEW_STANDARD):
         """Cache-validation fingerprint: per-shard (fragment uid,
         generation). The uid makes a recreated fragment (field dropped and
         re-made at the same path) distinct from its predecessor even when
         the generation counters collide. None when the field vanished
         (concurrent DDL) — caller falls back to the general path."""
         field = idx.field(field_name)
-        view = field.view(VIEW_STANDARD) if field is not None else None
+        view = field.view(view_name) if field is not None else None
         if view is None:
             return None
         gens = []
@@ -141,102 +189,308 @@ class StackedCountEvaluator:
                         else (frag.uid, frag.generation))
         return tuple(gens)
 
-    def _stack(self, idx, field_name, row_id, shards):
-        import jax.numpy as jnp
+    def _pool(self, key):
+        """Row-chunk stacks live in their own LRU pool (see
+        MAX_ROWS_STACK_BYTES)."""
+        if key[0] == "rows":
+            return self._rows_stacks, MAX_ROWS_STACK_BYTES
+        return self._stacks, MAX_STACK_BYTES
 
-        key = (idx.name, field_name, row_id, shards)
+    def _cache_get(self, key, gens):
+        pool, _ = self._pool(key)
+        with self._lock:
+            hit = pool.get(key)
+            if hit is not None and hit[0] == gens:
+                pool.move_to_end(key)
+                return hit[1]
+        return None
+
+    def _cache_put(self, key, gens, arrays, nbytes):
+        pool, budget = self._pool(key)
+        rows = pool is self._rows_stacks
+        with self._lock:
+            old = pool.pop(key, None)
+            if old is not None:
+                if rows:
+                    self._rows_stack_bytes -= old[2]
+                else:
+                    self._stack_bytes -= old[2]
+            pool[key] = (gens, arrays, nbytes)
+            if rows:
+                self._rows_stack_bytes += nbytes
+                while self._rows_stack_bytes > budget and len(pool) > 1:
+                    _, evicted = pool.popitem(last=False)
+                    self._rows_stack_bytes -= evicted[2]
+            else:
+                self._stack_bytes += nbytes
+                while self._stack_bytes > budget and len(pool) > 1:
+                    _, evicted = pool.popitem(last=False)
+                    self._stack_bytes -= evicted[2]
+
+    def leaf_stack(self, idx, field_name, row_id, shards):
+        """Cached [S, W] device stack of one row over `shards`."""
+        key = ("leaf", idx.name, field_name, row_id, shards)
         gens = self._fragment_gens(idx, field_name, shards)
         if gens is None:
             return None
-        with self._lock:
-            hit = self._stacks.get(key)
-            if hit is not None and hit[0] == gens:
-                self._stacks.move_to_end(key)
-                return hit[1]
+        hit = self._cache_get(key, gens)
+        if hit is not None:
+            return hit
         field = idx.field(field_name)
         view = field.view(VIEW_STANDARD) if field is not None else None
         if view is None:
             return None
-        import jax
-
-        rows = []
-        zeros = None
-        for shard in shards:
-            frag = view.fragment(shard)
-            plane = None if frag is None else frag.row_plane(row_id)
-            if plane is None:
-                if zeros is None:
-                    zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
-                plane = zeros
-            rows.append(np.asarray(plane))
-        sharding = self._stack_sharding()
-        if sharding is not None:
-            # zero-pad to a device multiple; zero rows are count-neutral
-            n_dev = len(sharding.device_set)
-            pad = (-len(rows)) % n_dev
-            if pad:
-                if zeros is None:
-                    zeros = np.zeros(WORDS_PER_ROW, dtype=np.uint32)
-                rows.extend([zeros] * pad)
-            stack = jax.device_put(np.stack(rows), sharding)
-        else:
-            stack = jnp.asarray(np.stack(rows))
-        nbytes = len(rows) * WORDS_PER_ROW * 4
-        with self._lock:
-            old = self._stacks.pop(key, None)
-            if old is not None:
-                self._stack_bytes -= old[2]
-            self._stacks[key] = (gens, stack, nbytes)
-            self._stack_bytes += nbytes
-            while self._stack_bytes > MAX_STACK_BYTES and len(self._stacks) > 1:
-                _, evicted = self._stacks.popitem(last=False)
-                self._stack_bytes -= evicted[2]
+        host = self._host_rows(view, [row_id], shards)
+        stack = self._place(host[0], shard_axis=0)
+        self._cache_put(key, gens, stack, stack.size * 4)
         return stack
 
-    # -- compiled tree evaluation -------------------------------------------
+    def _host_rows(self, view, row_ids, shards):
+        """Host [R, S_padded, W] uint32 gather of rows over shards."""
+        out = np.zeros((len(row_ids), self._padded_len(shards),
+                        WORDS_PER_ROW), dtype=np.uint32)
+        for j, shard in enumerate(shards):
+            frag = view.fragment(shard)
+            if frag is None:
+                continue
+            for i, row_id in enumerate(row_ids):
+                plane = frag.row_plane(row_id)
+                if plane is not None:
+                    out[i, j] = np.asarray(plane)
+        return out
 
-    def _fn(self, sig, arity):
+    def rows_stack(self, idx, field_name, row_chunk, shards,
+                   view_name=VIEW_STANDARD, cache=True):
+        """Cached [R, S, W] device stack of a chunk of rows (TopN/GroupBy
+        candidates). `row_chunk` must be a tuple (cache key). cache=False
+        builds a transient stack (freed after use) — callers pass it when
+        the full candidate set exceeds the rows pool, so oversized scans
+        don't churn out every reusable chunk."""
+        key = ("rows", idx.name, field_name, view_name, row_chunk, shards)
+        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        if gens is None:
+            return None
+        hit = self._cache_get(key, gens)
+        if hit is not None:
+            return hit
+        field = idx.field(field_name)
+        view = field.view(view_name) if field is not None else None
+        if view is None:
+            return None
+        host = self._host_rows(view, list(row_chunk), shards)
+        stack = self._place(host, shard_axis=1)
+        if cache:
+            self._cache_put(key, gens, stack, stack.size * 4)
+        return stack
+
+    def bsi_stack(self, idx, field_name, shards):
+        """Cached (planes [D,S,W], sign [S,W], exists [S,W]) device stacks
+        of a BSI field's bit-plane rows (reference layout fragment.go:91-93).
+        None when the field/view vanished."""
+        field = idx.field(field_name)
+        if field is None:
+            return None
+        view_name = field.bsi_view_name()
+        depth = field.options.bit_depth
+        key = ("bsi", idx.name, field_name, depth, shards)
+        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        if gens is None:
+            return None
+        hit = self._cache_get(key, gens)
+        if hit is not None:
+            return hit
+        view = field.view(view_name)
+        if view is None:
+            return None
+        rows = [BSI_EXISTS_BIT, BSI_SIGN_BIT] + [
+            BSI_OFFSET_BIT + i for i in range(depth)]
+        host = self._host_rows(view, rows, shards)
+        arr = self._place(host, shard_axis=1)
+        arrays = (arr[2:], arr[1], arr[0])  # planes, sign, exists
+        self._cache_put(key, gens, arrays, arr.size * 4)
+        return arrays
+
+    def row_chunk_size(self, shards):
+        """Rows per [R, S, W] chunk under the CHUNK_BYTES budget."""
+        return max(
+            1, CHUNK_BYTES // (self._padded_len(shards) * WORDS_PER_ROW * 4))
+
+    # -- compiled kernels ----------------------------------------------------
+
+    def _get_fn(self, key, build):
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self._fns.move_to_end(key)
+                return fn
+        fn = build()
+        with self._lock:
+            self._fns[key] = fn
+            while len(self._fns) > MAX_FNS:
+                self._fns.popitem(last=False)
+        return fn
+
+    @staticmethod
+    def _tree_eval(sig, stacks):
+        if sig[0] == "leaf":
+            return stacks[sig[1]]
+        op, subs = sig
+        acc = StackedEvaluator._tree_eval(subs[0], stacks)
+        for s in subs[1:]:
+            p = StackedEvaluator._tree_eval(s, stacks)
+            if op == "&":
+                acc = acc & p
+            elif op == "|":
+                acc = acc | p
+            elif op == "^":
+                acc = acc ^ p
+            else:
+                acc = acc & ~p
+        return acc
+
+    def _count_fn(self, sig, arity):
+        """Tree -> (hi, lo) int32 popcount totals over all shards."""
         import jax
         import jax.numpy as jnp
 
-        with self._lock:
-            fn = self._fns.get((sig, arity))
-            if fn is not None:
-                self._fns.move_to_end((sig, arity))
-        if fn is None:
-            def ev(node, stacks):
-                if node[0] == "leaf":
-                    return stacks[node[1]]
-                op, subs = node
-                acc = ev(subs[0], stacks)
-                for s in subs[1:]:
-                    p = ev(s, stacks)
-                    if op == "&":
-                        acc = acc & p
-                    elif op == "|":
-                        acc = acc | p
-                    elif op == "^":
-                        acc = acc ^ p
-                    else:
-                        acc = acc & ~p
-                return acc
-
+        def build():
             @jax.jit
             def fn(*stacks):
-                # int32 accumulate matches the other count kernels (safe:
-                # a count never exceeds the <2^31 column universe served
-                # per node; see bench.py)
-                acc = ev(sig, stacks)
-                return jnp.sum(
-                    jax.lax.population_count(acc).astype(jnp.int32))
+                acc = self._tree_eval(sig, stacks)
+                per_shard = jnp.sum(
+                    jax.lax.population_count(acc).astype(jnp.int32),
+                    axis=-1)
+                return bitplane.hi_lo(per_shard)
 
-            with self._lock:
-                self._fns[(sig, arity)] = fn
-                while len(self._fns) > MAX_FNS:
-                    self._fns.popitem(last=False)
-        return fn
+            return fn
 
-    # -- entry ---------------------------------------------------------------
+        return self._get_fn(("count", sig, arity), build)
+
+    def _plane_fn(self, sig, arity):
+        """Tree -> combined [S, W] plane stack (filter materialization)."""
+        import jax
+
+        def build():
+            @jax.jit
+            def fn(*stacks):
+                return self._tree_eval(sig, stacks)
+
+            return fn
+
+        return self._get_fn(("plane", sig, arity), build)
+
+    def _row_counts_fn(self, has_filt):
+        """(rows [R,S,W], filt [S,W]?) -> (hi [R], lo [R]) counts of
+        rows ∩ filter over all shards."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            def counts(rows, filt):
+                x = rows & filt[None] if has_filt else rows
+                per_shard = jnp.sum(
+                    jax.lax.population_count(x).astype(jnp.int32), axis=-1)
+                return bitplane.hi_lo(per_shard, axis=-1)
+
+            if has_filt:
+                return jax.jit(lambda rows, filt: counts(rows, filt))
+            return jax.jit(lambda rows: counts(rows, None))
+
+        return self._get_fn(("row_counts", has_filt), build)
+
+    def _sum_fn(self, has_filt):
+        """(planes [D,S,W], sign, exists, filt?) -> per-plane positive and
+        negative popcounts + consider count, all as (hi, lo) pairs
+        (reference: fragment.sum fragment.go:1068)."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            def kernel(planes, sign, exists, filt):
+                consider = exists & filt if has_filt else exists
+                pos = consider & ~sign
+                neg = consider & sign
+                pc = jnp.sum(jax.lax.population_count(
+                    planes & pos[None]).astype(jnp.int32), axis=-1)  # [D,S]
+                nc = jnp.sum(jax.lax.population_count(
+                    planes & neg[None]).astype(jnp.int32), axis=-1)
+                cc = jnp.sum(jax.lax.population_count(
+                    consider).astype(jnp.int32), axis=-1)            # [S]
+                return (*bitplane.hi_lo(pc, axis=-1),
+                        *bitplane.hi_lo(nc, axis=-1),
+                        *bitplane.hi_lo(cc))
+
+            if has_filt:
+                return jax.jit(kernel)
+            return jax.jit(
+                lambda planes, sign, exists: kernel(
+                    planes, sign, exists, None))
+
+        return self._get_fn(("sum", has_filt), build)
+
+    def _minmax_fn(self, has_filt, is_max):
+        """One-dispatch global Min/Max over stacked BSI planes.
+
+        Computes both the positive-branch and negative-branch narrowing
+        walks (ops.bsi min/max_unsigned work unchanged on [D,S,W] planes
+        with [S,W] filters — the scans are elementwise with global any())
+        and selects per the reference's sign rules (fragment.go:1110-1227):
+        Max: highest positive else closest-to-zero negative; Min: most
+        negative else lowest positive. Returns (empty, use_neg, bits [D],
+        cnt_hi, cnt_lo)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import bsi as bsi_ops
+
+        def build():
+            def kernel(planes, sign, exists, filt):
+                consider = exists & filt if has_filt else exists
+                pos = consider & ~sign
+                neg = consider & sign
+                has_pos = jnp.any(pos != 0)
+                has_neg = jnp.any(neg != 0)
+                empty = ~(has_pos | has_neg)
+                if is_max:
+                    # highest positive, else closest-to-zero negative
+                    b_pos, f_pos = bsi_ops.max_unsigned(planes, pos)
+                    b_neg, f_neg = bsi_ops.min_unsigned(planes, neg)
+                    use_neg = ~has_pos
+                else:
+                    # most negative, else lowest positive
+                    b_neg, f_neg = bsi_ops.max_unsigned(planes, neg)
+                    b_pos, f_pos = bsi_ops.min_unsigned(planes, pos)
+                    use_neg = has_neg
+                bits = jnp.where(use_neg, b_neg, b_pos)
+                final = jnp.where(use_neg, f_neg, f_pos)
+                per_shard = jnp.sum(
+                    jax.lax.population_count(final).astype(jnp.int32),
+                    axis=-1)
+                return (empty, use_neg, bits, *bitplane.hi_lo(per_shard))
+
+            if has_filt:
+                return jax.jit(kernel)
+            return jax.jit(
+                lambda planes, sign, exists: kernel(
+                    planes, sign, exists, None))
+
+        return self._get_fn(("minmax", has_filt, is_max), build)
+
+    # -- public entry points -------------------------------------------------
+
+    def _gather(self, idx, call, shards):
+        """Shared tree-coverage + leaf-stack gather: (sig, stacks) or None
+        when the tree isn't stack-coverable or a leaf's field vanished
+        (concurrent DDL) — callers fall back to the per-shard path."""
+        leaves = {}
+        sig = self.signature(idx, call, leaves)
+        if sig is None or not leaves:
+            return None
+        ordered = sorted(leaves.items(), key=lambda kv: kv[1])
+        stacks = [self.leaf_stack(idx, f, r, shards) for (f, r), _ in ordered]
+        if any(s is None for s in stacks):
+            return None
+        return sig, stacks
 
     def try_count(self, idx, call_child, shards):
         """Count(call_child) over `shards` in one dispatch, or None when
@@ -244,17 +498,129 @@ class StackedCountEvaluator:
         shards = tuple(shards)
         if len(shards) < MIN_SHARDS:
             return None
-        leaves = {}
-        sig = self.signature(idx, call_child, leaves)
-        if sig is None or not leaves:
+        gathered = self._gather(idx, call_child, shards)
+        if gathered is None:
             return None
-        ordered = sorted(leaves.items(), key=lambda kv: kv[1])
-        stacks = [self._stack(idx, f, r, shards) for (f, r), _ in ordered]
-        if any(s is None for s in stacks):
-            return None  # concurrent DDL: fall back to the general path
-        return int(self._fn(sig, len(stacks))(*stacks))
+        sig, stacks = gathered
+        self.dispatches += 1
+        hi, lo = self._count_fn(sig, len(stacks))(*stacks)
+        return combine_hi_lo(hi, lo)
+
+    def filter_stack(self, idx, call, shards):
+        """Materialize a bitmap call tree as one [S, W] device stack.
+        Returns (covered, stack): covered=False means the tree has shapes
+        the stacked path can't express (fall back to per-shard);
+        stack=None with covered=True means "no filter given"."""
+        if call is None:
+            return True, None
+        shards = tuple(shards)
+        gathered = self._gather(idx, call, shards)
+        if gathered is None:
+            return False, None
+        sig, stacks = gathered
+        self.dispatches += 1
+        return True, self._plane_fn(sig, len(stacks))(*stacks)
+
+    def row_counts(self, idx, field_name, row_ids, filt, shards,
+                   view_name=VIEW_STANDARD):
+        """{row_id: exact count of row ∩ filt summed over shards}, in
+        O(rows/chunk) dispatches independent of the shard count. `filt` is
+        a [S, W] device stack from filter_stack (or None). Returns None
+        when the field/view vanished mid-query."""
+        shards = tuple(shards)
+        out = {}
+        chunk_size = self.row_chunk_size(shards)
+        # Oversized candidate sets can't all stay resident: build those
+        # chunks transiently instead of churning out every cached chunk.
+        total_bytes = (len(row_ids) * self._padded_len(shards)
+                       * WORDS_PER_ROW * 4)
+        cache = total_bytes <= MAX_ROWS_STACK_BYTES
+        fn = self._row_counts_fn(filt is not None)
+        pending = []
+        import jax
+
+        for i in range(0, len(row_ids), chunk_size):
+            chunk = tuple(row_ids[i:i + chunk_size])
+            stack = self.rows_stack(idx, field_name, chunk, shards,
+                                    view_name, cache=cache)
+            if stack is None:
+                return None
+            self.dispatches += 1
+            hi_lo = fn(stack, filt) if filt is not None else fn(stack)
+            if not cache:
+                # Transient chunks: block before building the next one so
+                # peak HBM stays ~CHUNK_BYTES instead of the whole
+                # candidate set queued in flight.
+                jax.block_until_ready(hi_lo)
+            pending.append((chunk, hi_lo))
+        for chunk, (hi, lo) in pending:
+            totals = combine_hi_lo(hi, lo)
+            for j, row_id in enumerate(chunk):
+                out[row_id] = int(totals[j])
+        return out
+
+    def try_sum(self, idx, field, filter_call, shards):
+        """(signed magnitude total, count) for Sum over stacked BSI planes,
+        or None to fall back. The caller adds base*count (field.go:1583)."""
+        shards = tuple(shards)
+        if len(shards) < MIN_SHARDS:
+            return None
+        covered, filt = self.filter_stack(idx, filter_call, shards)
+        if not covered:
+            return None
+        data = self.bsi_stack(idx, field.name, shards)
+        if data is None:
+            return None
+        planes, sign, exists = data
+        fn = self._sum_fn(filt is not None)
+        self.dispatches += 1
+        if filt is not None:
+            res = fn(planes, sign, exists, filt)
+        else:
+            res = fn(planes, sign, exists)
+        p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = [np.asarray(r) for r in res]
+        pos = combine_hi_lo(p_hi, p_lo)
+        neg = combine_hi_lo(n_hi, n_lo)
+        total = 0
+        for i in range(planes.shape[0]):
+            total += (int(pos[i]) - int(neg[i])) << i
+        return total, combine_hi_lo(c_hi, c_lo)
+
+    def try_minmax(self, idx, field, filter_call, shards, is_max):
+        """(signed magnitude, count) of the Min/Max value over stacked BSI
+        planes, or None to fall back; (None, 0) when no column qualifies.
+        The caller adds base (reference: fragment.go:1110-1227)."""
+        shards = tuple(shards)
+        if len(shards) < MIN_SHARDS:
+            return None
+        covered, filt = self.filter_stack(idx, filter_call, shards)
+        if not covered:
+            return None
+        data = self.bsi_stack(idx, field.name, shards)
+        if data is None:
+            return None
+        planes, sign, exists = data
+        fn = self._minmax_fn(filt is not None, is_max)
+        self.dispatches += 1
+        if filt is not None:
+            empty, use_neg, bits, c_hi, c_lo = fn(planes, sign, exists, filt)
+        else:
+            empty, use_neg, bits, c_hi, c_lo = fn(planes, sign, exists)
+        if bool(empty):
+            return None, 0
+        bits = np.asarray(bits)
+        mag = sum(int(b) << i for i, b in enumerate(bits))
+        if bool(use_neg):
+            mag = -mag
+        return mag, combine_hi_lo(c_hi, c_lo)
 
     def invalidate(self):
         with self._lock:
             self._stacks.clear()
             self._stack_bytes = 0
+            self._rows_stacks.clear()
+            self._rows_stack_bytes = 0
+
+
+# Backwards-compatible name (the evaluator originally covered Count only).
+StackedCountEvaluator = StackedEvaluator

@@ -38,7 +38,30 @@ __all__ = [
     "plane_from_columns",
     "columns_from_plane",
     "topn_counts",
+    "hi_lo",
+    "combine_hi_lo",
 ]
+
+
+def hi_lo(per_shard_counts, axis=None):
+    """Overflow-safe cross-shard reduce: per-shard popcounts fit int32
+    (<= SHARD_WIDTH = 2^20 bits/shard) but totals can exceed 2^31 past 2048
+    shards, and TPU JAX runs with x64 disabled — so reduce (count >> 16) and
+    (count & 0xffff) separately and recombine on host with exact Python ints
+    (combine_hi_lo). Safe to 2^15 shards (~34 trillion columns/node).
+
+    This is THE one overflow-splitting contract; every cross-shard count
+    reduce in the framework routes through this pair of helpers."""
+    return (jnp.sum(per_shard_counts >> 16, axis=axis),
+            jnp.sum(per_shard_counts & 0xFFFF, axis=axis))
+
+
+def combine_hi_lo(hi, lo):
+    """Exact host total from a hi_lo() reduce pair (elementwise for array
+    pairs, Python int for scalars)."""
+    if np.ndim(hi):
+        return (np.asarray(hi).astype(np.int64) << 16) + np.asarray(lo)
+    return (int(hi) << 16) + int(lo)
 
 
 @jax.jit

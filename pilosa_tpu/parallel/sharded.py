@@ -63,17 +63,28 @@ def _is_multi_device(x):
 _count_expr_cache = {}
 
 
+def _hi_lo():
+    """Canonical overflow-safe reduce helpers (ops.bitplane), imported
+    lazily to preserve this module's jax-free import time."""
+    from ..ops.bitplane import combine_hi_lo, hi_lo
+
+    return hi_lo, combine_hi_lo
+
+
 def _count_expr_fn(ops, arity):
     """Module-cached jitted fused expression-count kernel (one compile per
-    (ops, arity), reused forever)."""
+    (ops, arity), reused forever). Returns an (hi, lo) int32 pair."""
     jax, jnp = _jax()
 
+    hi_lo, _ = _hi_lo()
     fn = _count_expr_cache.get((ops, arity))
     if fn is None:
         @jax.jit
         def fn(*planes):
             acc = apply_op_chain(planes[0], planes[1:], ops)
-            return jnp.sum(jax.lax.population_count(acc).astype(jnp.int32))
+            per_shard = jnp.sum(
+                jax.lax.population_count(acc).astype(jnp.int32), axis=-1)
+            return hi_lo(per_shard)
 
         _count_expr_cache[(ops, arity)] = fn
     return fn
@@ -107,11 +118,15 @@ class QueryKernels:
         ops/pallas_kernels.py)."""
         from ..ops import pallas_kernels
 
-        if pallas_kernels.enabled() and not any(
+        # Pallas accumulates a plain int32 total, so route stacks that
+        # could exceed 2^31 set bits (>2048 full shards) to the hi/lo jnp
+        # path — the pallas kernel has no hi/lo split yet.
+        n_bits = planes[0].shape[0] * planes[0].shape[1] * 32
+        if pallas_kernels.enabled() and n_bits < 2**31 and not any(
                 _is_multi_device(p) for p in planes):
-            return pallas_kernels.count_expr_stack(
-                planes[0], planes[1:], tuple(ops))
-        return _count_expr_fn(ops, len(planes))(*planes)
+            return int(pallas_kernels.count_expr_stack(
+                planes[0], planes[1:], tuple(ops)))
+        return _hi_lo()[1](*_count_expr_fn(ops, len(planes))(*planes))
 
 
 # ---------------------------------------------------------------------------
@@ -162,20 +177,24 @@ class ShardedQueryEngine:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        hi_lo, combine = _hi_lo()
         key = ("count_intersect",)
         fn = self._compiled.get(key)
         if fn is None:
             @jax.jit
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(P(self.axis), P(self.axis)),
-                     out_specs=P())
+                     out_specs=(P(), P()))
             def fn(a, b):
-                local = jnp.sum(
-                    jax.lax.population_count(a & b).astype(jnp.int32))
-                return jax.lax.psum(local[None], self.axis)
+                per_shard = jnp.sum(
+                    jax.lax.population_count(a & b).astype(jnp.int32),
+                    axis=-1)
+                hi, lo = hi_lo(per_shard)
+                return (jax.lax.psum(hi, self.axis),
+                        jax.lax.psum(lo, self.axis))
 
             self._compiled[key] = fn
-        return int(fn(a, b)[0])
+        return combine(*fn(a, b))
 
     def query_step(self, planes, ops):
         """Distributed fused expression count: planes is a list of [S, W]
@@ -186,21 +205,25 @@ class ShardedQueryEngine:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        hi_lo, combine = _hi_lo()
         key = ("expr", ops, len(planes))
         fn = self._compiled.get(key)
         if fn is None:
             @jax.jit
             @partial(shard_map, mesh=self.mesh,
                      in_specs=tuple(P(self.axis) for _ in planes),
-                     out_specs=P())
+                     out_specs=(P(), P()))
             def fn(*planes):
                 acc = apply_op_chain(planes[0], planes[1:], ops)
-                local = jnp.sum(
-                    jax.lax.population_count(acc).astype(jnp.int32))
-                return jax.lax.psum(local[None], self.axis)
+                per_shard = jnp.sum(
+                    jax.lax.population_count(acc).astype(jnp.int32),
+                    axis=-1)
+                hi, lo = hi_lo(per_shard)
+                return (jax.lax.psum(hi, self.axis),
+                        jax.lax.psum(lo, self.axis))
 
             self._compiled[key] = fn
-        return int(fn(*planes)[0])
+        return combine(*fn(*planes))
 
     def topn_step(self, stack, filter_stack, k):
         """Distributed TopN over a [R, S, W] row×shard stack: per-device
@@ -211,24 +234,31 @@ class ShardedQueryEngine:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        key = ("topn", k)
+        hi_lo, combine = _hi_lo()
+        key = ("topn",)
         fn = self._compiled.get(key)
         if fn is None:
-            @partial(jax.jit, static_argnames=())
+            @jax.jit
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(P(None, self.axis), P(self.axis)),
                      out_specs=(P(), P()))
             def fn(stack, filt):
-                counts = jnp.sum(
-                    jax.lax.population_count(stack & filt[None]),
-                    axis=(1, 2)).astype(jnp.int32)
-                total = jax.lax.psum(counts, self.axis)
-                vals, idx = jax.lax.top_k(total, k)
-                return vals, idx
+                per_shard = jnp.sum(
+                    jax.lax.population_count(
+                        stack & filt[None]).astype(jnp.int32),
+                    axis=-1)                      # [R, S_local]
+                hi, lo = hi_lo(per_shard, axis=-1)
+                return (jax.lax.psum(hi, self.axis),
+                        jax.lax.psum(lo, self.axis))
 
             self._compiled[key] = fn
-        vals, idx = fn(stack, filter_stack)
-        return np.asarray(vals), np.asarray(idx)
+        hi, lo = fn(stack, filter_stack)
+        # Exact int64 totals on host, then top-k (device top_k would need
+        # the combined counts in one register, which overflows int32 past
+        # 2048 shards).
+        totals = combine(hi, lo)
+        order = np.lexsort((np.arange(len(totals)), -totals))[:k]
+        return totals[order], order.astype(np.int32)
 
     def sum_step(self, planes, sign, exists, filt):
         """Distributed BSI Sum: per-plane popcounts psum'd over shards.
@@ -237,31 +267,36 @@ class ShardedQueryEngine:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        key = ("sum", planes.shape[0])
+        hi_lo, combine = _hi_lo()
+        key = ("sum",)
         fn = self._compiled.get(key)
         if fn is None:
             @jax.jit
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(P(None, self.axis), P(self.axis),
                                P(self.axis), P(self.axis)),
-                     out_specs=(P(), P(), P()))
+                     out_specs=(P(), P(), P(), P(), P(), P()))
             def fn(planes, sign, exists, filt):
                 consider = exists & filt
                 pos = consider & ~sign
                 neg = consider & sign
-                pc = jnp.sum(jax.lax.population_count(planes & pos[None]),
-                             axis=(1, 2)).astype(jnp.int32)
-                nc = jnp.sum(jax.lax.population_count(planes & neg[None]),
-                             axis=(1, 2)).astype(jnp.int32)
-                cnt = jnp.sum(
-                    jax.lax.population_count(consider).astype(jnp.int32))
-                return (jax.lax.psum(pc, self.axis),
-                        jax.lax.psum(nc, self.axis),
-                        jax.lax.psum(cnt[None], self.axis))
+                pc = jnp.sum(jax.lax.population_count(
+                    planes & pos[None]).astype(jnp.int32), axis=-1)
+                nc = jnp.sum(jax.lax.population_count(
+                    planes & neg[None]).astype(jnp.int32), axis=-1)
+                cc = jnp.sum(jax.lax.population_count(
+                    consider).astype(jnp.int32), axis=-1)
+                p_hi, p_lo = hi_lo(pc, axis=-1)
+                n_hi, n_lo = hi_lo(nc, axis=-1)
+                c_hi, c_lo = hi_lo(cc)
+                return tuple(jax.lax.psum(x, self.axis)
+                             for x in (p_hi, p_lo, n_hi, n_lo, c_hi, c_lo))
 
             self._compiled[key] = fn
-        pos, neg, cnt = fn(planes, sign, exists, filt)
-        pos, neg = np.asarray(pos), np.asarray(neg)
-        total = sum(int(pos[i]) << i for i in range(len(pos)))
-        total -= sum(int(neg[i]) << i for i in range(len(neg)))
-        return total, int(np.asarray(cnt)[0])
+        p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = [
+            np.asarray(x) for x in fn(planes, sign, exists, filt)]
+        total = 0
+        for i in range(planes.shape[0]):
+            total += combine(p_hi[i], p_lo[i]) << i
+            total -= combine(n_hi[i], n_lo[i]) << i
+        return total, combine(c_hi, c_lo)

@@ -1,0 +1,277 @@
+"""Stacked serving paths (exec/stacked.py round 3): TopN/Sum/Min/Max/GroupBy
+in O(1)-in-shards dispatches, TopN threshold/tanimotoThreshold (reference:
+executor.go:947-995, fragment.top fragment.go:1570-1700), and int32-overflow
+safety past 2048 shards (hi/lo split reduces)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.result import Pair
+from pilosa_tpu.server.api import API
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder)
+    api.create_index("i")
+    yield holder, api, Executor(holder)
+    holder.close()
+
+
+def _mk_set_field(api, name="f"):
+    api.create_field("i", name)
+    return name
+
+
+# ---------------------------------------------------------------- aggregates
+
+
+def test_sum_min_max_stacked_matches_per_shard(env):
+    holder, api, e = env
+    api.create_field("i", "v", FieldOptions.int_field(min=-500, max=500))
+    rng = np.random.default_rng(11)
+    cols = rng.choice(4 * SHARD_WIDTH, size=300, replace=False)
+    vals = rng.integers(-500, 501, size=300)
+    f = holder.index("i").field("v")
+    for c, v in zip(cols.tolist(), vals.tolist()):
+        f.set_value(c, v)
+
+    got = e.execute("i", "Sum(field=v)")[0]
+    assert got.val == int(vals.sum())
+    assert got.count == 300
+    assert e.execute("i", "Min(field=v)")[0].val == int(vals.min())
+    assert e.execute("i", "Max(field=v)")[0].val == int(vals.max())
+    # counts of columns achieving the extremum
+    assert e.execute("i", "Min(field=v)")[0].count == \
+        int((vals == vals.min()).sum())
+    assert e.execute("i", "Max(field=v)")[0].count == \
+        int((vals == vals.max()).sum())
+
+    # filtered variants against a hand-computed subset
+    api.create_field("i", "s")
+    sel = cols[: len(cols) // 2]
+    api.import_bits("i", "s", [7] * len(sel), sel.tolist())
+    want = vals[: len(cols) // 2]
+    got = e.execute("i", "Sum(Row(s=7), field=v)")[0]
+    assert got.val == int(want.sum())
+    assert got.count == len(sel)
+    assert e.execute("i", "Min(Row(s=7), field=v)")[0].val == int(want.min())
+    assert e.execute("i", "Max(Row(s=7), field=v)")[0].val == int(want.max())
+
+    # per-shard fallback agrees (single-shard execution is below MIN_SHARDS)
+    per_shard_sum = sum(
+        e.execute("i", "Sum(field=v)", shards=[s])[0].val
+        for s in range(4))
+    assert per_shard_sum == int(vals.sum())
+
+
+def test_groupby_stacked_matches_per_shard(env):
+    holder, api, e = env
+    api.create_field("i", "a")
+    api.create_field("i", "b")
+    rng = np.random.default_rng(13)
+    n = 400
+    cols = rng.choice(3 * SHARD_WIDTH, size=n, replace=False)
+    rows_a = rng.integers(0, 3, size=n)
+    rows_b = rng.integers(10, 13, size=n)
+    api.import_bits("i", "a", rows_a.tolist(), cols.tolist())
+    api.import_bits("i", "b", rows_b.tolist(), cols.tolist())
+
+    got = e.execute("i", "GroupBy(Rows(a), Rows(b))")[0]
+    want = {}
+    for ra, rb in zip(rows_a.tolist(), rows_b.tolist()):
+        want[(ra, rb)] = want.get((ra, rb), 0) + 1
+    got_map = {
+        (g.group[0].row_id, g.group[1].row_id): g.count for g in got}
+    assert got_map == {k: v for k, v in want.items() if v > 0}
+
+    # filter= goes through the stacked path too
+    api.create_field("i", "flt")
+    sel = cols[cols % 2 == 0]
+    api.import_bits("i", "flt", [1] * len(sel), sel.tolist())
+    got = e.execute("i", "GroupBy(Rows(a), Rows(b), filter=Row(flt=1))")[0]
+    want = {}
+    for c, ra, rb in zip(cols.tolist(), rows_a.tolist(), rows_b.tolist()):
+        if c % 2 == 0:
+            want[(ra, rb)] = want.get((ra, rb), 0) + 1
+    got_map = {
+        (g.group[0].row_id, g.group[1].row_id): g.count for g in got}
+    assert got_map == {k: v for k, v in want.items() if v > 0}
+
+
+# ------------------------------------------------------- threshold / tanimoto
+
+
+def _tanimoto_fixture(api):
+    """The reference's TestFragment_Tanimoto data
+    (fragment_internal_test.go:1463): src={1,2,3}; row 100={1,2,3,200},
+    row 101={1,3}, row 102={1,2,10,12}."""
+    api.create_field("i", "f")
+    api.create_field("i", "other")
+    api.import_bits("i", "other", [9, 9, 9], [1, 2, 3])
+    api.import_bits("i", "f",
+                    [100, 100, 100, 100, 101, 101, 102, 102, 102, 102],
+                    [1, 3, 2, 200, 1, 3, 1, 2, 10, 12])
+
+
+def test_topn_tanimoto(env):
+    holder, api, e = env
+    _tanimoto_fixture(api)
+    got = e.execute(
+        "i", "TopN(f, Row(other=9), tanimotoThreshold=50)")[0]
+    assert got == [Pair(100, 3), Pair(101, 2)]
+
+
+def test_topn_tanimoto_zero_is_ignored(env):
+    holder, api, e = env
+    _tanimoto_fixture(api)
+    got = e.execute(
+        "i", "TopN(f, Row(other=9), tanimotoThreshold=0)")[0]
+    assert got == [Pair(100, 3), Pair(101, 2), Pair(102, 2)]
+
+
+def test_topn_tanimoto_out_of_range(env):
+    holder, api, e = env
+    _tanimoto_fixture(api)
+    from pilosa_tpu.exec.executor import ExecError
+
+    with pytest.raises(ExecError, match="Tanimoto Threshold is from 1 to 100"):
+        e.execute("i", "TopN(f, Row(other=9), tanimotoThreshold=101)")
+
+
+def test_topn_threshold(env):
+    holder, api, e = env
+    api.create_field("i", "f")
+    # row 1: 5 cols, row 2: 3 cols, row 3: 1 col — spread over shards
+    api.import_bits(
+        "i", "f",
+        [1, 1, 1, 1, 1, 2, 2, 2, 3],
+        [0, 1, SHARD_WIDTH, SHARD_WIDTH + 1, 2 * SHARD_WIDTH, 2, 3,
+         SHARD_WIDTH + 2, 4])
+    assert e.execute("i", "TopN(f, threshold=3)")[0] == \
+        [Pair(1, 5), Pair(2, 3)]
+    assert e.execute("i", "TopN(f, threshold=4)")[0] == [Pair(1, 5)]
+    # threshold also applies to intersection counts when filtered
+    api.create_field("i", "g")
+    api.import_bits("i", "g", [9, 9, 9], [0, 1, 2])
+    got = e.execute("i", "TopN(f, Row(g=9), threshold=2)")[0]
+    assert got == [Pair(1, 2)]  # f=1 ∩ g=9 = {0,1}; f=2 ∩ = {2} dropped
+
+
+def test_topn_on_int_field_errors(env):
+    holder, api, e = env
+    api.create_field("i", "v", FieldOptions.int_field(min=0, max=10))
+    from pilosa_tpu.exec.executor import ExecError
+
+    with pytest.raises(ExecError, match="cannot compute TopN"):
+        e.execute("i", "TopN(v, n=2)")
+
+
+# ----------------------------------------------------- dispatch-count bound
+
+
+def _build_index(tmp_path, name, n_shards):
+    holder = Holder(str(tmp_path / name)).open()
+    api = API(holder)
+    api.create_index("i")
+    api.create_field("i", "f")
+    api.create_field("i", "flt")
+    rows, cols = [], []
+    for s in range(n_shards):
+        for r in range(6):
+            rows += [r, r]
+            cols += [s * SHARD_WIDTH + r, s * SHARD_WIDTH + 64 + r]
+    api.import_bits("i", "f", rows, cols)
+    api.import_bits("i", "flt", [1] * n_shards,
+                    [s * SHARD_WIDTH for s in range(n_shards)])
+    return holder, api
+
+
+@pytest.mark.parametrize("query", [
+    "Count(Row(f=1))",
+    "TopN(f, n=3)",
+    "TopN(f, Row(flt=1), n=3)",
+    "GroupBy(Rows(f))",
+])
+def test_dispatch_count_independent_of_shards(tmp_path, query):
+    """The serving guarantee: kernel dispatches per query do NOT grow with
+    the shard count (the reference's per-shard mapReduce is O(shards);
+    executor.go:2455)."""
+    counts = {}
+    for n_shards in (3, 6):
+        holder, api = _build_index(tmp_path, f"d{n_shards}", n_shards)
+        e = Executor(holder)
+        e.execute("i", query)  # warm stacks + compiles
+        before = e._stacked.dispatches
+        e.execute("i", query)
+        counts[n_shards] = e._stacked.dispatches - before
+        holder.close()
+    assert counts[3] == counts[6], counts
+    assert counts[3] > 0  # the stacked path actually ran
+
+
+def test_stacked_rows_cache_hit(tmp_path):
+    """Second identical TopN must not rebuild host stacks (no row_plane
+    calls): the generation-fingerprinted cache serves it entirely."""
+    from pilosa_tpu.core import fragment as fragment_mod
+
+    holder, api = _build_index(tmp_path, "cache", 4)
+    e = Executor(holder)
+    e.execute("i", "TopN(f, n=3)")
+    calls = {"n": 0}
+    orig = fragment_mod.Fragment.row_plane
+
+    def counted(self, row_id):
+        calls["n"] += 1
+        return orig(self, row_id)
+
+    fragment_mod.Fragment.row_plane = counted
+    try:
+        r1 = e.execute("i", "TopN(f, n=3)")
+        assert calls["n"] == 0
+    finally:
+        fragment_mod.Fragment.row_plane = orig
+    holder.close()
+
+
+# ------------------------------------------------------------ int32 overflow
+
+
+def test_count_overflow_past_2048_shards():
+    """Counts past 2^31 must not wrap: the hi/lo int32 split reduce
+    (VERDICT r2: int32 accumulate wrapped at >=2048 shards)."""
+    import jax.numpy as jnp
+
+    from pilosa_tpu.exec.stacked import StackedEvaluator, combine_hi_lo
+    from pilosa_tpu.parallel import QueryKernels
+
+    S = 2056  # > 2048; all-ones planes -> 2056 * 2^20 bits > 2^31
+    ones = jnp.full((S, WORDS_PER_ROW), 0xFFFFFFFF, dtype=jnp.uint32)
+    want = S * SHARD_WIDTH
+    assert want > 2**31
+
+    assert QueryKernels.count_expr([ones, ones], "&") == want
+
+    ev = StackedEvaluator()
+    hi, lo = ev._count_fn(("leaf", 0), 1)(ones)
+    assert combine_hi_lo(hi, lo) == want
+
+    hi, lo = ev._row_counts_fn(False)(ones[None])
+    assert combine_hi_lo(hi[0], lo[0]) == want
+
+
+def test_count_overflow_over_mesh():
+    import jax
+
+    from pilosa_tpu.parallel import ShardedQueryEngine
+
+    engine = ShardedQueryEngine(devices=jax.devices()[:8])
+    S = 2056
+    ones = np.full((S, WORDS_PER_ROW), 0xFFFFFFFF, dtype=np.uint32)
+    da = engine.place(ones)
+    assert engine.count_intersect(da, da) == S * SHARD_WIDTH
+    assert engine.query_step([da, da], "|") == S * SHARD_WIDTH
