@@ -67,8 +67,40 @@ def cmd_server(args):
         config["node-id"] = args.node_id
     if getattr(args, "replicas", None):
         config["replicas"] = args.replicas
+    if getattr(args, "spmd", False):
+        config["spmd"] = True
+    if getattr(args, "spmd_port", None):
+        config["spmd-port"] = args.spmd_port
     host, _, port = config["bind"].partition(":")
     data_dir = os.path.expanduser(config["data-dir"])
+
+    # SPMD pod mode: join the global JAX distributed system BEFORE anything
+    # can initialize a backend (same once-only constraint as platform
+    # selection). Process id = this node's position in the (identical on
+    # every node) --cluster-hosts list; the coordinator service lives on
+    # the first listed host.
+    spmd_requested = bool(config.get("spmd"))
+    if spmd_requested and not config.get("cluster-hosts"):
+        raise SystemExit("--spmd requires --cluster-hosts")
+    if spmd_requested:
+        from .cluster.spmd import SpmdDataPlane
+
+        spmd_hosts = [h.strip() for h in
+                      config["cluster-hosts"].split(",") if h.strip()]
+        local_ref = config.get("node-id") or config["bind"]
+        if local_ref.startswith("http"):
+            local_ref = local_ref.split("//", 1)[1]
+        norm = [h.split("//", 1)[1] if h.startswith("http") else h
+                for h in spmd_hosts]
+        if local_ref not in norm:
+            raise SystemExit(
+                f"--spmd: node id {local_ref!r} not in --cluster-hosts")
+        coord_host = norm[0].rsplit(":", 1)[0]
+        coord_port = int(config.get("spmd-port", 27121))
+        SpmdDataPlane.initialize(
+            coordinator_address=f"{coord_host}:{coord_port}",
+            num_processes=len(norm),
+            process_id=norm.index(local_ref))
 
     holder = Holder(data_dir, max_op_n=config.get("max-op-n")).open()
 
@@ -106,8 +138,15 @@ def cmd_server(args):
     # flag wins over config file; unset disables the log.
     lqt = getattr(args, "long_query_time", None) \
         or config.get("long-query-time")
+    spmd = None
+    if spmd_requested and cluster is not None:
+        from .cluster.spmd import SpmdDataPlane
+        from .server import Client as _SpmdClient
+
+        spmd = SpmdDataPlane(holder, cluster, _SpmdClient)
     api = API(holder, cluster=cluster,
-              long_query_time=parse_duration(lqt) if lqt else None)
+              long_query_time=parse_duration(lqt) if lqt else None,
+              spmd=spmd)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -452,6 +491,15 @@ def main(argv=None):
                    help="this node's id (defaults to host:port of --bind)")
     p.add_argument("--replicas", type=int, default=None,
                    help="replication factor (default 1)")
+    p.add_argument("--spmd", action="store_true", default=False,
+                   help="join a global JAX distributed system across the "
+                        "cluster: coverable Count merges ride collectives "
+                        "(ICI/DCN on TPU pods, gloo on CPU) instead of the "
+                        "HTTP data plane")
+    p.add_argument("--spmd-port", type=int, default=None,
+                   help="TCP port of the JAX distributed coordinator "
+                        "service on the FIRST --cluster-hosts node "
+                        "(default 27121)")
     p.add_argument("--bind", default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config", default=None)

@@ -164,10 +164,11 @@ class ClusterExecutor:
     Wraps exec.Executor. With a single-node cluster (or none) it degrades
     to purely local execution."""
 
-    def __init__(self, holder, cluster, client_factory):
+    def __init__(self, holder, cluster, client_factory, spmd=None):
         self.holder = holder
         self.cluster = cluster
         self.client_factory = client_factory
+        self.spmd = spmd
         self.local = Executor(holder)
 
     # -- public entry --------------------------------------------------------
@@ -260,6 +261,14 @@ class ClusterExecutor:
     def _map_reduce(self, idx, call, shards, opt):
         if shards is None:
             shards = self.cluster_shards(idx)
+        # SPMD data plane: coverable Count trees merge over collectives
+        # (cluster/spmd.py); anything it declines falls through to the
+        # HTTP merge below.
+        if self.spmd is not None and call.name == "Count" \
+                and len(call.children) == 1:
+            result = self.spmd.try_count(idx, call.children[0], shards)
+            if result is not None:
+                return result
         by_node = self.cluster.shards_by_node(idx.name, shards)
 
         lock = threading.Lock()

@@ -1,0 +1,325 @@
+"""Pod-scale SPMD data plane: cross-node query merge over collectives.
+
+The reference merges cross-node partial results over HTTP/protobuf
+(executor.remoteExec executor.go:2414, http/client.go:268) — the
+coordinator POSTs per-node shard lists and sums JSON/proto responses. In
+SPMD mode that data plane is replaced by the accelerator fabric: every
+server process joins ONE global JAX distributed system
+(`jax.distributed.initialize` — gloo across CPU hosts, ICI/DCN collectives
+on TPU pods), each query leaf materializes as a single globally-sharded
+[shards, words] array whose per-process blocks come from that node's own
+fragments, and one jit-compiled count program runs on every process in
+lockstep — XLA inserts the cross-process all-reduce, so counts merge as a
+psum riding the fabric instead of JSON over REST.
+
+HTTP remains the CONTROL plane (SURVEY §2 "distributed communication
+backend": control over DCN, data merge over ICI): the cluster coordinator
+announces each step via POST /internal/spmd/step, every process (including
+the coordinator) executes the identical program, and the replicated scalar
+result is read locally — no result bytes cross HTTP.
+
+Execution model (multi-controller SPMD):
+- Only the cluster coordinator node initiates steps, and it serializes
+  them under a local lock; peer processes execute steps from their HTTP
+  handler thread under the same per-process lock. With a single initiator
+  this yields an identical step order on every process — the requirement
+  for collectives to rendezvous correctly.
+- Queries arriving at non-coordinator nodes (and calls the stacked
+  signature can't express) use the HTTP merge path unchanged; SPMD is a
+  fast path, never a correctness dependency.
+- Steps are gated on every node being READY: a process that never joins a
+  collective would hang the others, so degraded clusters fall back to the
+  HTTP path (which has per-replica retry).
+
+Count totals use the framework-wide (hi, lo) int32 split reduce
+(ops.bitplane.hi_lo) — exact past 2^31 bits without x64.
+"""
+
+import threading
+
+import numpy as np
+
+from ..pql import call_to_pql, parse
+from ..shardwidth import WORDS_PER_ROW
+
+
+class SpmdError(Exception):
+    pass
+
+
+class SpmdDataPlane:
+    #: process-wide init guard (jax.distributed.initialize is once-only)
+    _initialized = False
+
+    @classmethod
+    def initialize(cls, coordinator_address, num_processes, process_id):
+        """Join the global JAX distributed system. MUST run before any JAX
+        backend initializes in this process (same constraint as platform
+        selection; see cli._honor_jax_platforms_env)."""
+        if cls._initialized:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        cls._initialized = True
+
+    #: seconds a step announcement may block (first-query jit compile +
+    #: collective rendezvous on a cold pod can far exceed the default 30s)
+    STEP_TIMEOUT = 300
+    #: seconds for the cheap pre-flight validation round
+    VALIDATE_TIMEOUT = 5
+
+    def __init__(self, holder, cluster, client_factory):
+        self.holder = holder
+        self.cluster = cluster
+        self.client_factory = client_factory
+        self._lock = threading.Lock()  # one step at a time per process
+        self._mesh = None
+        self._fns = {}
+        self._step_id = 0
+        self.steps_run = 0  # observability: /internal/spmd/stats
+        # The JAX process set is fixed at startup (initialize is
+        # once-only); if the cluster later grows or shrinks, SPMD must
+        # decline — new nodes are not mesh participants.
+        self._boot_node_ids = tuple(sorted(n.id for n in cluster.nodes)) \
+            if cluster is not None else ()
+
+    # -- mesh ----------------------------------------------------------------
+
+    def _global_sharding(self):
+        """NamedSharding over the GLOBAL device list, process-major, so
+        each process's addressable block is contiguous along the shard
+        axis (what make_array_from_process_local_data fills)."""
+        if self._mesh is None:
+            import jax
+
+            devices = sorted(jax.devices(),
+                             key=lambda d: (d.process_index, d.id))
+            self._mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
+        import jax
+
+        return jax.sharding.NamedSharding(
+            self._mesh, jax.sharding.PartitionSpec("shards"))
+
+    def _local_device_count(self):
+        import jax
+
+        return len(jax.local_devices())
+
+    def _num_processes(self):
+        import jax
+
+        return jax.process_count()
+
+    # -- signature helper ----------------------------------------------------
+
+    def _signature(self, idx, call):
+        """Tree signature for SPMD coverage. Same shape rules as the
+        stacked evaluator (shared walk: exec.stacked.tree_signature) but
+        leaf checks consult only REPLICATED state (the schema): every
+        process must derive the IDENTICAL signature or the collective
+        desyncs, and local view/fragment existence differs per node (a node
+        that owns no shards of a field simply contributes zero planes)."""
+        from ..exec.stacked import tree_signature
+
+        def leaf(idx, field_name, row_id, leaves):
+            if idx.field(field_name) is None:
+                return None
+            key = (field_name, int(row_id))
+            if key not in leaves:
+                leaves[key] = len(leaves)
+            return ("leaf", leaves[key])
+
+        leaves = {}
+        sig = tree_signature(idx, call, leaves, leaf)
+        if sig is None or not leaves:
+            return None
+        ordered = sorted(leaves.items(), key=lambda kv: kv[1])
+        return sig, [key for key, _ in ordered]
+
+    # -- coordinator entry ---------------------------------------------------
+
+    def try_count(self, idx, call, shards):
+        """Count(call) merged over the global mesh, or None to fall back
+        to the HTTP merge path."""
+        cluster = self.cluster
+        if cluster is None or len(cluster.nodes) < 2:
+            return None
+        coord = cluster.coordinator
+        if coord is None or coord.id != cluster.local_id:
+            return None  # single initiator keeps step order global
+        from .node import NODE_STATE_READY
+
+        if any(n.state != NODE_STATE_READY for n in cluster.nodes):
+            return None  # a hung participant would stall the collective
+        if tuple(sorted(n.id for n in cluster.nodes)) != self._boot_node_ids:
+            return None  # membership changed since jax.distributed init
+        if self._signature(idx, call) is None:
+            return None
+
+        by_node = cluster.shards_by_node(idx.name, list(shards))
+        segments = {node.id: sorted(s) for node, s in by_node.items()}
+        # every process contributes an equal-shaped block (zero planes for
+        # nodes with fewer/no shards), padded to its device multiple
+        dev_pp = self._local_device_count()
+        longest = max((len(s) for s in segments.values()), default=0)
+        seg_len = max(dev_pp, ((longest + dev_pp - 1) // dev_pp) * dev_pp)
+
+        step = {
+            "index": idx.name,
+            "pql": call_to_pql(call),
+            "segments": segments,
+            "seg_len": seg_len,
+            "dev_pp": dev_pp,
+            "nodes": list(self._boot_node_ids),
+        }
+
+        # Pre-flight: every peer must confirm it can execute this step
+        # (spmd enabled, schema in sync, matching device count) with a
+        # short deadline, BEFORE anyone enters the collective — a peer
+        # that never joins would stall the whole mesh with no way out.
+        if not self._validate_on_peers(step):
+            return None
+
+        with self._lock:
+            self._step_id += 1
+            step["step"] = self._step_id
+            errors = []
+
+            def post(node):
+                try:
+                    client = self.client_factory(node.uri)
+                    client.timeout = self.STEP_TIMEOUT
+                    client.spmd_step(step)
+                except Exception as e:  # surfaced after the collective
+                    errors.append((node.id, e))
+
+            threads = [threading.Thread(target=post, args=(n,))
+                       for n in cluster.peers()]
+            for t in threads:
+                t.start()
+            # join the collective ourselves — peers are inside run_step now
+            result = self._run_step_locked(step)
+            for t in threads:
+                t.join()
+        if errors:
+            # We hold a replicated result, so every process DID join the
+            # collective; these are post-collective transport errors (lost
+            # responses). Log, don't fail the query.
+            import sys
+
+            print(f"spmd: post-collective peer errors (result kept): "
+                  f"{errors}", file=sys.stderr)
+        return result
+
+    def _validate_on_peers(self, step):
+        oks = []
+
+        def probe(node):
+            try:
+                client = self.client_factory(node.uri)
+                client.timeout = self.VALIDATE_TIMEOUT
+                resp = client.spmd_validate(step)
+                oks.append(bool(resp.get("ok")))
+            except Exception:
+                oks.append(False)
+
+        threads = [threading.Thread(target=probe, args=(n,))
+                   for n in self.cluster.peers()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return all(oks) and len(oks) == len(self.cluster.peers())
+
+    def validate(self, step):
+        """Peer-side pre-flight check (POST /internal/spmd/validate)."""
+        idx = self.holder.index(step["index"])
+        if idx is None:
+            return {"ok": False, "reason": "index not found"}
+        if self._signature(idx, parse(step["pql"]).calls[0]) is None:
+            return {"ok": False, "reason": "tree not coverable"}
+        if int(step["dev_pp"]) != self._local_device_count():
+            return {"ok": False, "reason": "device count mismatch"}
+        if tuple(step.get("nodes", ())) != self._boot_node_ids:
+            return {"ok": False, "reason": "membership mismatch"}
+        return {"ok": True}
+
+    # -- step execution (every process) --------------------------------------
+
+    def run_step(self, step):
+        """HTTP-handler entry for peer processes."""
+        with self._lock:
+            return self._run_step_locked(step)
+
+    def _run_step_locked(self, step):
+        import jax
+
+        idx = self.holder.index(step["index"])
+        if idx is None:
+            raise SpmdError(f"index not found: {step['index']}")
+        call = parse(step["pql"]).calls[0]
+        sig_leaves = self._signature(idx, call)
+        if sig_leaves is None:
+            raise SpmdError(
+                f"step tree not coverable on this node: {step['pql']}")
+        sig, leaf_keys = sig_leaves
+
+        my_shards = step["segments"].get(self.cluster.local_id, [])
+        seg_len = int(step["seg_len"])
+        if len(my_shards) > seg_len:
+            raise SpmdError("segment exceeds seg_len")
+        n_proc = self._num_processes()
+        sharding = self._global_sharding()
+        global_shape = (n_proc * seg_len, WORDS_PER_ROW)
+
+        from ..core.view import VIEW_STANDARD
+
+        arrays = []
+        for field_name, row_id in leaf_keys:
+            local = np.zeros((seg_len, WORDS_PER_ROW), dtype=np.uint32)
+            field = idx.field(field_name)
+            view = field.view(VIEW_STANDARD) if field is not None else None
+            if view is not None:
+                for j, shard in enumerate(my_shards):
+                    frag = view.fragment(shard)
+                    if frag is not None:
+                        plane = frag.row_plane(row_id)
+                        if plane is not None:
+                            local[j] = np.asarray(plane)
+            arrays.append(jax.make_array_from_process_local_data(
+                sharding, local, global_shape=global_shape))
+
+        fn = self._count_fn(sig, len(arrays))
+        hi, lo = fn(*arrays)
+        self.steps_run += 1
+        from ..ops.bitplane import combine_hi_lo
+
+        return combine_hi_lo(hi, lo)
+
+    def _count_fn(self, sig, arity):
+        import jax
+        import jax.numpy as jnp
+
+        from ..exec.stacked import StackedEvaluator
+        from ..ops.bitplane import hi_lo
+
+        fn = self._fns.get((sig, arity))
+        if fn is None:
+            @jax.jit
+            def fn(*stacks):
+                acc = StackedEvaluator._tree_eval(sig, stacks)
+                per_shard = jnp.sum(
+                    jax.lax.population_count(acc).astype(jnp.int32),
+                    axis=-1)
+                return hi_lo(per_shard)
+
+            self._fns[(sig, arity)] = fn
+        return fn
+
+    def stats(self):
+        return {"steps": self.steps_run,
+                "initialized": type(self)._initialized}

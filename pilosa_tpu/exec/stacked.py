@@ -66,6 +66,46 @@ from ..ops import bitplane  # noqa: E402
 from ..ops.bitplane import combine_hi_lo  # noqa: E402  (canonical helper)
 
 
+def tree_signature(idx, call, leaves, leaf):
+    """THE coverage walk for stacked/SPMD fast paths: turns a bitmap call
+    tree into an operator signature over leaf slots, or None when any
+    shape isn't expressible (conditions, time ranges, Shift, keys, ...).
+    `leaf(idx, field_name, row_id, leaves)` decides leaf eligibility —
+    the stacked evaluator requires a local standard view; the SPMD plane
+    checks replicated schema only (cluster/spmd.py)."""
+    name = call.name
+    if name in ("Row", "Range"):
+        if call.has_conditions() or "from" in call.args \
+                or "to" in call.args:
+            return None
+        field_name = call.field_arg()
+        if field_name is None:
+            return None
+        row_id = call.args.get(field_name)
+        if isinstance(row_id, bool):
+            row_id = int(row_id)
+        if not isinstance(row_id, int):
+            return None
+        return leaf(idx, field_name, row_id, leaves)
+    if name in _OPS and call.children:
+        subs = tuple(tree_signature(idx, c, leaves, leaf)
+                     for c in call.children)
+        if any(s is None for s in subs):
+            return None
+        return (_OPS[name], subs)
+    if name == "Not" and len(call.children) == 1 \
+            and idx.options.track_existence \
+            and idx.field(EXISTENCE_FIELD_NAME) is not None:
+        child = tree_signature(idx, call.children[0], leaves, leaf)
+        if child is None:
+            return None
+        exists = leaf(idx, EXISTENCE_FIELD_NAME, 0, leaves)
+        if exists is None:
+            return None
+        return ("-", (exists, child))
+    return None
+
+
 class StackedEvaluator:
     def __init__(self):
         self._stacks = OrderedDict()  # key -> (gens, device arrays, nbytes)
@@ -137,37 +177,7 @@ class StackedEvaluator:
         """Tree signature with leaf slots, or None when the tree has any
         shape the fast path doesn't cover (conditions, time ranges, Shift,
         keys...). None means: use the general per-shard path."""
-        name = call.name
-        if name in ("Row", "Range"):
-            if call.has_conditions() or "from" in call.args \
-                    or "to" in call.args:
-                return None
-            field_name = call.field_arg()
-            if field_name is None:
-                return None
-            row_id = call.args.get(field_name)
-            if isinstance(row_id, bool):
-                row_id = int(row_id)
-            if not isinstance(row_id, int):
-                return None
-            return self._leaf(idx, field_name, row_id, leaves)
-        if name in _OPS and call.children:
-            subs = tuple(self.signature(idx, c, leaves)
-                         for c in call.children)
-            if any(s is None for s in subs):
-                return None
-            return (_OPS[name], subs)
-        if name == "Not" and len(call.children) == 1 \
-                and idx.options.track_existence \
-                and idx.field(EXISTENCE_FIELD_NAME) is not None:
-            child = self.signature(idx, call.children[0], leaves)
-            if child is None:
-                return None
-            exists = self._leaf(idx, EXISTENCE_FIELD_NAME, 0, leaves)
-            if exists is None:
-                return None
-            return ("-", (exists, child))
-        return None
+        return tree_signature(idx, call, leaves, self._leaf)
 
     # -- stack cache ---------------------------------------------------------
 

@@ -106,12 +106,15 @@ def result_to_json(result):
 
 class API:
     def __init__(self, holder, cluster=None, client_factory=None,
-                 long_query_time=None, logger=None):
+                 long_query_time=None, logger=None, spmd=None):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
         self.holder = holder
         self.cluster = cluster
+        # SPMD data plane (cluster/spmd.py): when set, coverable Count
+        # merges ride collectives instead of the HTTP data plane.
+        self.spmd = spmd
         # Slow-query threshold in seconds (reference: LongQueryTime
         # api.go:1157); None disables the log.
         self.long_query_time = long_query_time
@@ -122,11 +125,19 @@ class API:
         if cluster is not None:
             from ..cluster import ResizeManager
 
-            self.executor = ClusterExecutor(holder, cluster, client_factory)
+            self.executor = ClusterExecutor(holder, cluster, client_factory,
+                                            spmd=spmd)
             self.resize = ResizeManager(holder, cluster, self.client_factory)
         else:
             self.executor = Executor(holder)
             self.resize = None
+
+    def spmd_step(self, step):
+        """Execute one SPMD collective step announced by the coordinator
+        (control plane endpoint POST /internal/spmd/step)."""
+        if self.spmd is None:
+            raise ApiError("spmd mode not enabled on this node")
+        return self.spmd.run_step(step)
 
     # -- queries ------------------------------------------------------------
 

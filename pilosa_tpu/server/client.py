@@ -169,6 +169,23 @@ class Client:
         gossip; here an internal endpoint)."""
         return self._request("GET", f"/internal/index/{index}/shards")
 
+    def spmd_step(self, step):
+        """Announce an SPMD collective step (control plane; the result
+        bytes themselves merge over the accelerator fabric)."""
+        import json as _json
+
+        return self._request(
+            "POST", "/internal/spmd/step", _json.dumps(step).encode(),
+            content_type="application/json")
+
+    def spmd_validate(self, step):
+        """Pre-flight an SPMD step (cheap, short-deadline)."""
+        import json as _json
+
+        return self._request(
+            "POST", "/internal/spmd/validate", _json.dumps(step).encode(),
+            content_type="application/json")
+
     def shard_fragments(self, index, shard):
         """(field, view) fragments a node holds for one shard (resize
         streaming discovery)."""

@@ -81,6 +81,10 @@ class PilosaHTTPServer:
                   r"/fragments",
                   self._get_shard_fragments),
             Route("POST", r"/internal/cluster/message", self._post_message),
+            Route("POST", r"/internal/spmd/step", self._post_spmd_step),
+            Route("POST", r"/internal/spmd/validate",
+                  self._post_spmd_validate),
+            Route("GET", r"/internal/spmd/stats", self._get_spmd_stats),
             Route("GET", r"/internal/fragment/blocks",
                   self._get_fragment_blocks),
             Route("GET", r"/internal/fragment/block/data",
@@ -261,6 +265,24 @@ class PilosaHTTPServer:
     def _post_message(self, req):
         self.api.receive_message(req.body)
         return None
+
+    def _post_spmd_step(self, req):
+        import json as _json
+
+        value = self.api.spmd_step(_json.loads(req.body.decode()))
+        return {"value": value}
+
+    def _post_spmd_validate(self, req):
+        import json as _json
+
+        if self.api.spmd is None:
+            return {"ok": False, "reason": "spmd mode not enabled"}
+        return self.api.spmd.validate(_json.loads(req.body.decode()))
+
+    def _get_spmd_stats(self, req):
+        if self.api.spmd is None:
+            return {"steps": 0, "initialized": False}
+        return self.api.spmd.stats()
 
     def _q1(self, req, key, default=None):
         return req.query.get(key, [default])[0]

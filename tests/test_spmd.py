@@ -1,0 +1,176 @@
+"""Pod-scale SPMD data plane (cluster/spmd.py): a real 3-process cluster
+joined into one global JAX distributed system (gloo collectives on CPU —
+the same code path XLA lowers to ICI/DCN collectives on TPU pods). Count
+merges must ride the collective (every process runs the psum step), not the
+HTTP JSON data plane (reference architecture: remoteExec executor.go:2414).
+
+Mirrors tests/test_clusterproc.py's subprocess harness; gated by the same
+env switch."""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PILOSA_TPU_PROC_TESTS", "1") == "0",
+    reason="process cluster tests disabled")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class SpmdCluster:
+    """3 real server processes with --spmd: 2 virtual CPU devices each ->
+    a 6-device global mesh across processes."""
+
+    def __init__(self, n=3):
+        ports = _free_ports(n + 1)
+        self.ports, spmd_port = ports[:n], ports[n]
+        hosts = ",".join(f"127.0.0.1:{p}" for p in self.ports)
+        self.dirs = [tempfile.mkdtemp(prefix="pilosa-spmd-")
+                     for _ in range(n)]
+        self.procs = []
+        self.logs = []
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
+        for i, port in enumerate(self.ports):
+            log = open(os.path.join(self.dirs[i], "server.log"), "w")
+            self.logs.append(log)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                 "--bind", f"127.0.0.1:{port}",
+                 "--data-dir", self.dirs[i],
+                 "--cluster-hosts", hosts,
+                 "--replicas", "1",
+                 "--spmd", "--spmd-port", str(spmd_port)],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+        self.clients = [Client(f"http://127.0.0.1:{p}", timeout=120)
+                        for p in self.ports]
+
+    def wait_ready(self, timeout=180):
+        deadline = time.time() + timeout
+        pending = set(range(len(self.procs)))
+        while pending and time.time() < deadline:
+            for i in list(pending):
+                if self.procs[i].poll() is not None:
+                    raise RuntimeError(f"node {i} exited: " + self._tail(i))
+                try:
+                    self.clients[i]._request("GET", "/status")
+                    pending.discard(i)
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        if pending:
+            raise TimeoutError(
+                f"nodes {sorted(pending)} not ready: "
+                + "; ".join(self._tail(i) for i in pending))
+
+    def _tail(self, i):
+        self.logs[i].flush()
+        with open(self.logs[i].name) as f:
+            return f.read()[-2000:]
+
+    def close(self):
+        for p in self.procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self.logs:
+            log.close()
+        import shutil
+
+        for d in self.dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = SpmdCluster(3)
+    # the cluster sorts nodes by id; the coordinator (SPMD initiator) is
+    # the lexically-smallest host:port, not necessarily clients[0]
+    c.coord = min(range(3), key=lambda i: f"127.0.0.1:{c.ports[i]}")
+    try:
+        c.wait_ready()
+        c.clients[0].create_index("sp")
+        c.clients[0].create_field("sp", "f")
+        c.clients[0].create_field("sp", "g")
+        time.sleep(1.0)  # DDL broadcast settles
+        yield c
+    finally:
+        c.close()
+
+
+def _spmd_steps(cluster):
+    return [cl._request("GET", "/internal/spmd/stats")["steps"]
+            for cl in cluster.clients]
+
+
+def test_count_merges_via_collective(cluster):
+    coord = cluster.clients[cluster.coord]
+    # bits across 6 shards -> shards land on all 3 nodes (jump hash)
+    cols = [s * SHARD_WIDTH + off for s in range(6) for off in (0, 7, 99)]
+    coord.import_bits("sp", "f", [1] * len(cols), cols)
+    coord.import_bits("sp", "g", [2] * (len(cols) // 2), cols[::2])
+
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "Count(Row(f=1))")["results"][0]
+    assert got == len(cols)
+    got = coord.query(
+        "sp", "Count(Intersect(Row(f=1), Row(g=2)))")["results"][0]
+    assert got == len(cols[::2])
+    after = _spmd_steps(cluster)
+    # EVERY process ran both collective steps: the merge was a psum over
+    # the global mesh, not an HTTP JSON reduce.
+    assert all(a - b == 2 for a, b in zip(after, before)), (before, after)
+
+
+def test_non_coordinator_and_uncoverable_fall_back(cluster):
+    coord = cluster.clients[cluster.coord]
+    other = cluster.clients[(cluster.coord + 1) % 3]
+    cols = [s * SHARD_WIDTH + 3 for s in range(4)]
+    coord.import_bits("sp", "f", [9] * len(cols), cols)
+    time.sleep(0.2)
+    before = _spmd_steps(cluster)
+    # query via a non-coordinator node: HTTP merge, same answer
+    got = other.query("sp", "Count(Row(f=9))")["results"][0]
+    assert got == len(cols)
+    # an uncoverable tree (Shift) on the coordinator: HTTP merge
+    got = coord.query(
+        "sp", "Count(Shift(Row(f=9), n=1))")["results"][0]
+    assert got == len(cols)
+    after = _spmd_steps(cluster)
+    assert after == before, (before, after)
+
+
+def test_row_results_still_http(cluster):
+    """Non-Count calls keep the HTTP data plane and stay correct."""
+    cols = [s * SHARD_WIDTH + 11 for s in range(3)]
+    cluster.clients[0].import_bits("sp", "f", [42] * len(cols), cols)
+    time.sleep(0.2)
+    got = cluster.clients[0].query("sp", "Row(f=42)")["results"][0]
+    assert sorted(got["columns"]) == sorted(cols)
