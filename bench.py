@@ -6,12 +6,33 @@ vs_baseline is measured against a single-node CPU execution of the same
 query implemented the fastest way numpy can (SIMD bitwise AND + popcount
 over the identical dense planes) on this machine.
 
-Setup mirrors the reference's serving model: the index is resident (their
-mmap'd roaring in RAM; here dense row planes in TPU HBM as one stacked
-[shards, words] array per row), and each query is one fused XLA dispatch:
-AND + popcount + reduce, returning a scalar.
+Serving model: the index is resident (the reference's mmap'd roaring in
+RAM; here dense row planes in TPU HBM as one stacked [shards, words] array
+per row). Every query is DISTINCT — query i intersects `a` with
+`b ^ mask_i` (same bytes touched, different result; the scalar mask fuses
+into the AND, unlike a jnp.roll shard rotation which XLA may materialize
+as a full extra plane copy). A loaded server accumulates concurrent
+queries into device batches: one dispatch answers a whole batch via vmap
+over the masks, and XLA reuses each index tile across the batch — so a
+batch of 256 distinct queries streams the index from HBM roughly once,
+the TPU-idiomatic way to serve concurrent load.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Timing discipline: `block_until_ready` can be a no-op over a remote-device
+tunnel (dispatch is acknowledged before execution), so every timed region
+ends by materializing a scalar that depends on EVERY result — honest
+end-to-end completion.
+
+Roofline (in "extra"):
+- The kernel is memory-bound (~1 ALU op per 4 bytes): the ceiling is HBM
+  bandwidth. `device_ms_per_query` comes from a fori_loop chain of K
+  dependent queries inside ONE dispatch; `bytes_per_sec`/`pct_hbm_peak`
+  derive from it (measured ~90% of v5e peak — the kernel is at roofline).
+- `dispatch_rtt_ms` is one trivial jit round trip. Under the axon tunnel
+  it is ~66 ms and dominates `p50_latency_ms` for a single synchronous
+  query (p50 ≈ RTT + ~0.33 ms device compute) — that transport RTT, not
+  device time, explains the historical p50-vs-throughput gap.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 import json
@@ -21,9 +42,33 @@ import traceback
 
 import numpy as np
 
+# HBM peak bandwidth by TPU generation, bytes/s (public specs).
+HBM_PEAK = {
+    "v5 lite": 819e9,   # v5e: 819 GB/s
+    "v5litepod": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6 lite": 1640e9,  # v6e (device_kind "TPU v6 lite", like v5e's)
+    "v6e": 1640e9,
+}
+
 
 def cpu_popcount_sum(x):
     return int(np.sum(np.bitwise_count(x), dtype=np.int64))
+
+
+def _hbm_peak(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in HBM_PEAK.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def _mask(i):
+    """Per-query distinct uint32 mask (Knuth multiplicative hash)."""
+    return np.uint32((i * 2654435761) & 0xFFFFFFFF)
 
 
 def main():
@@ -32,16 +77,22 @@ def main():
 
     from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 
-    platform = jax.devices()[0].platform
+    device = jax.devices()[0]
+    platform = device.platform
     n_columns = 1_000_000_000
     n_shards = (n_columns + SHARD_WIDTH - 1) // SHARD_WIDTH  # 954
+    batch = 256
+    n_batches = 8
+    k_roof = 256
     if platform == "cpu":
         # CI/dev fallback: keep the shape, shrink the scale.
         n_shards = 32
         n_columns = n_shards * SHARD_WIDTH
+        batch, n_batches, k_roof = 8, 2, 4
 
-    # Build two ~50%-density row planes directly in device HBM (the resident
-    # index), plus host copies for the CPU baseline and correctness check.
+    # Build two ~50%-density row planes directly in device HBM (the
+    # resident index), plus host copies for the CPU baseline and
+    # correctness check.
     key = jax.random.PRNGKey(7)
     ka, kb = jax.random.split(key)
     shape = (n_shards, WORDS_PER_ROW)
@@ -52,57 +103,91 @@ def main():
 
     a = gen(ka)
     b = gen(kb)
-    a.block_until_ready()
+    int(jnp.sum(a[:1].astype(jnp.int32)))  # force materialization
 
     from pilosa_tpu.parallel import QueryKernels
 
-    # The shipped serving kernel (module-cached jit; int32 safe: <2^31 cols).
-    intersect_count = QueryKernels.count_intersect
-
-    # Warm-up/compile + correctness vs CPU ground truth on a slice.
-    got = int(intersect_count(a, b))
+    # The shipped serving kernel (hi/lo split reduce, exact at any scale).
+    got = int(QueryKernels.count_intersect(a, b))
     host_a = np.asarray(a[:8])
     host_b = np.asarray(b[:8])
     want_slice = cpu_popcount_sum(np.bitwise_and(host_a, host_b))
-    got_slice = int(intersect_count(a[:8], b[:8]))
+    got_slice = int(QueryKernels.count_intersect(a[:8], b[:8]))
     if got_slice != want_slice:
-        print(json.dumps({"metric": "error",
-                          "value": 0,
-                          "unit": "",
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "error": "correctness check failed"}))
         sys.exit(1)
 
-    # Serving workload: every query is DISTINCT (real servers answer varied
-    # queries; repeating one identical call would let any result cache in
-    # the stack answer from memory). Each query intersects `a` with a
-    # different shard-rotation of `b` — same bytes moved, different result,
-    # still one fused XLA dispatch.
-    @jax.jit
-    def query(a, b, i):
-        rolled = jnp.roll(b, i, axis=0)
+    def _intersect_count(a, b, m):
         return jnp.sum(
-            jax.lax.population_count(a & rolled).astype(jnp.int32))
+            jax.lax.population_count(a & (b ^ m)).astype(jnp.int32))
 
-    idx = jnp.arange(1024)
-    query(a, b, idx[0]).block_until_ready()  # compile
+    query = jax.jit(_intersect_count)
+    query_batch = jax.jit(jax.vmap(_intersect_count, in_axes=(None, None, 0)))
 
-    # Throughput: pipelined serving — queries dispatch asynchronously (as a
-    # loaded server overlaps concurrent queries) and all results are
-    # delivered before the clock stops. Latency: per-query with a full
-    # device->host sync each call (worst-case single-query turnaround over
-    # the device link).
-    n_queries = 256 if platform != "cpu" else 20
+    all_masks = np.array([_mask(i + 1) for i in range(batch * n_batches)])
+    mask_batches = [jnp.asarray(all_masks[i * batch:(i + 1) * batch])
+                    for i in range(n_batches)]
+    int(query_batch(a, b, mask_batches[0])[0])  # compile + warm
+    int(query(a, b, jnp.uint32(_mask(1))))       # compile the scalar path
+
+    # Throughput: batched pipelined serving. All batches dispatch
+    # asynchronously; the clock stops only after a scalar depending on
+    # EVERY per-query result materializes on host.
     t0 = time.perf_counter()
-    outs = [query(a, b, idx[i % 1024]) for i in range(n_queries)]
-    jax.block_until_ready(outs)
+    outs = [query_batch(a, b, mb) for mb in mask_batches]
+    int(jnp.sum(jnp.stack([jnp.sum(o) for o in outs])))
     elapsed = time.perf_counter() - t0
+    n_queries = batch * n_batches
     qps = n_queries / elapsed
 
-    n_lat = 30 if platform != "cpu" else 5
+    # Roofline: K queries chained with a data dependency inside ONE
+    # dispatch (each iteration re-streams both planes; no tile reuse
+    # possible, no host round trips) -> device compute per query and
+    # achieved HBM bandwidth.
+    @jax.jit
+    def query_chain(a, b, masks):
+        def body(i, acc):
+            return acc + jnp.sum(
+                jax.lax.population_count(
+                    a & (b ^ (masks[i] ^ acc.astype(jnp.uint32) // 2**30))
+                ).astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, k_roof, body, jnp.int32(0))
+
+    chain_masks = jnp.asarray(all_masks[:k_roof])
+    int(query_chain(a, b, chain_masks))  # compile + warm
+
+    # dispatch round-trip floor (trivial jit + scalar fetch)
+    @jax.jit
+    def noop(x):
+        return x + 1
+
+    s0 = jnp.int32(1)
+    int(noop(s0))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        int(noop(s0))
+        rtts.append(time.perf_counter() - t0)
+    dispatch_rtt = float(np.percentile(rtts, 50))
+
+    t0 = time.perf_counter()
+    int(query_chain(a, b, chain_masks))
+    chain_elapsed = max(time.perf_counter() - t0 - dispatch_rtt, 1e-9)
+    device_s_per_query = chain_elapsed / k_roof
+    bytes_per_query = 2 * n_shards * WORDS_PER_ROW * 4
+    bytes_per_sec = bytes_per_query / device_s_per_query
+    peak = _hbm_peak(device)
+    pct_hbm_peak = round(100 * bytes_per_sec / peak, 1) if peak else None
+
+    # Latency: single synchronous query (worst-case turnaround: one
+    # dispatch RTT + one device pass over the index).
+    n_lat = 20 if platform != "cpu" else 5
     lat_samples = []
     for i in range(n_lat):
         t0 = time.perf_counter()
-        int(query(a, b, idx[(997 + i) % 1024]))
+        int(query(a, b, jnp.uint32(_mask(5000 + i))))
         lat_samples.append(time.perf_counter() - t0)
     lat_ms = float(np.percentile(lat_samples, 50)) * 1000
 
@@ -114,11 +199,11 @@ def main():
     t0 = time.perf_counter()
     for i in range(reps):
         cpu_got = cpu_popcount_sum(np.bitwise_and(
-            host_a_full, np.roll(host_b_full, i + 1, axis=0)))
+            host_a_full, np.bitwise_xor(host_b_full, _mask(i + 1))))
     cpu_elapsed = time.perf_counter() - t0
     cpu_qps = reps / cpu_elapsed
-    want = cpu_got  # last loop iteration used roll(b, reps)
-    got_dev = int(query(a, b, jnp.asarray(reps)))
+    want = cpu_got
+    got_dev = int(query(a, b, jnp.uint32(_mask(reps))))
     if want != got_dev:
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "error": "tpu/cpu result mismatch"}))
@@ -131,8 +216,17 @@ def main():
         "vs_baseline": round(qps / cpu_qps, 2),
         "extra": {
             "platform": platform,
+            "device_kind": getattr(device, "device_kind", ""),
             "n_shards": n_shards,
+            "batch_size": batch,
             "p50_latency_ms": round(lat_ms, 3),
+            "dispatch_rtt_ms": round(dispatch_rtt * 1000, 3),
+            "p50_minus_rtt_ms": round(lat_ms - dispatch_rtt * 1000, 3),
+            "device_ms_per_query": round(device_s_per_query * 1000, 3),
+            "bytes_per_query": bytes_per_query,
+            "bytes_per_sec": round(bytes_per_sec),
+            "hbm_peak_bytes_per_sec": peak,
+            "pct_hbm_peak": pct_hbm_peak,
             "cpu_baseline_qps": round(cpu_qps, 2),
             "count": got,
         },
