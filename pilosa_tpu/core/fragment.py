@@ -82,6 +82,11 @@ class Fragment:
         self._file = None
         self._snapshot_pending = False
         self._row_ids_cache = None
+        # Mutex rows-vector: column offset -> row id, built lazily and
+        # maintained incrementally so single-bit mutex writes are O(1)
+        # instead of probing every row (reference: rowsVector
+        # fragment.go:3102). None = not built / invalidated by a bulk op.
+        self._mutex_vec = None
         self._lock = threading.RLock()
 
         # Device plane cache: rowID -> jax array; bumped generation
@@ -179,6 +184,8 @@ class Fragment:
         pos = self.pos(row_id, column_id)
         changed = self.storage.add(pos)
         if changed:
+            if self.mutexed and self._mutex_vec is not None:
+                self._mutex_vec[column_id % SHARD_WIDTH] = row_id
             self._append_op(encode_op(OP_ADD, value=pos))
             self._invalidate_row(row_id)
             self._cache_update(row_id)
@@ -192,6 +199,10 @@ class Fragment:
         pos = self.pos(row_id, column_id)
         changed = self.storage.remove(pos)
         if changed:
+            if self.mutexed and self._mutex_vec is not None:
+                off = column_id % SHARD_WIDTH
+                if int(self._mutex_vec[off]) == row_id:
+                    self._mutex_vec[off] = -1
             self._append_op(encode_op(OP_REMOVE, value=pos))
             self._invalidate_row(row_id)
             self._cache_update(row_id)
@@ -204,33 +215,54 @@ class Fragment:
         if existing is not None and existing != row_id:
             self._clear_bit_locked(existing, column_id)
 
+    def _mutex_vector(self):
+        """The mutex rows-vector (column offset -> row id, int32 array of
+        SHARD_WIDTH with -1 = unset, ~4 MB/fragment), built lazily with one
+        slice_range pass per row, then maintained incrementally by
+        _set_bit_locked/_clear_bit_locked (bulk ops invalidate or patch
+        it). O(1) lookups replace the per-write all-rows probe (reference:
+        rowsVector fragment.go:3102, boltRowsVector). Mutex fragments only
+        — non-mutexed fragments have no single-row-per-column invariant
+        and their writes don't maintain the vector."""
+        vec = self._mutex_vec
+        if vec is None:
+            vec = np.full(SHARD_WIDTH, -1, dtype=np.int32)
+            for row_id in self.row_ids():
+                base = row_id * SHARD_WIDTH
+                offs = (self.storage.slice_range(
+                    base, base + SHARD_WIDTH) - np.uint64(base)
+                ).astype(np.int64)
+                vec[offs] = row_id
+            self._mutex_vec = vec
+        return vec
+
     def row_for_column(self, column_id):
-        """First row containing the column, or None (mutex vector lookup,
-        reference: rowsVector fragment.go:3102)."""
-        for row_id in self.row_ids():
-            if self.storage.contains(self.pos(row_id, column_id)):
-                return row_id
-        return None
+        """Row containing the column, or None — O(1) mutex rows-vector
+        lookup (reference: rowsVector fragment.go:3102); falls back to a
+        storage scan on non-mutexed fragments (no maintained vector)."""
+        with self._lock:
+            if not self.mutexed:
+                for row_id in self.row_ids():
+                    if self.storage.contains(self.pos(row_id, column_id)):
+                        return row_id
+                return None
+            row = int(self._mutex_vector()[column_id % SHARD_WIDTH])
+            return None if row < 0 else row
 
     def rows_for_columns(self, column_ids):
-        """{column_id: row_id} for the given columns, one vectorized
-        intersection per existing row — avoids per-column full scans in
-        mutex bulk imports."""
-        col_by_offset = {int(c) % SHARD_WIDTH: int(c) for c in column_ids}
-        wanted = np.array(sorted(col_by_offset), dtype=np.uint64)
-        out = {}
-        for row_id in self.row_ids():
-            if len(wanted) == 0:
-                break
-            base = np.uint64(row_id * SHARD_WIDTH)
-            offs = self.storage.slice_range(
-                int(base), int(base) + SHARD_WIDTH) - base
-            hits = wanted[np.isin(wanted, offs)]
-            if len(hits):
-                for off in hits:
-                    out[col_by_offset[int(off)]] = row_id
-                wanted = wanted[~np.isin(wanted, hits)]
-        return out
+        """{column_id: row_id} for the given columns via the rows-vector
+        (mutex bulk imports)."""
+        with self._lock:
+            if not self.mutexed:
+                return {c: r for c in column_ids
+                        if (r := self.row_for_column(int(c))) is not None}
+            vec = self._mutex_vector()
+            out = {}
+            for c in column_ids:
+                row = int(vec[int(c) % SHARD_WIDTH])
+                if row >= 0:
+                    out[int(c)] = row
+            return out
 
     def contains(self, row_id, column_id):
         return self.storage.contains(self.pos(row_id, column_id))
@@ -332,6 +364,7 @@ class Fragment:
             for r, c in zip(row_ids, column_ids):
                 last[int(c)] = int(r)
             existing = self.rows_for_columns(list(last))
+            vec = self._mutex_vec  # built by rows_for_columns
             to_set, to_clear = [], []
             for c, r in last.items():
                 old = existing.get(c)
@@ -341,6 +374,13 @@ class Fragment:
                     to_clear.append(self.pos(old, c))
                 to_set.append(self.pos(r, c))
             changed += self.import_positions(to_set, to_clear)
+            # import_positions invalidated the vector; the bulk outcome is
+            # exactly last-write-wins per column, so patch it back instead
+            # of paying a full rebuild on the next mutex write.
+            if vec is not None:
+                for c, r in last.items():
+                    vec[c % SHARD_WIDTH] = r
+                self._mutex_vec = vec
             return changed
 
     def import_roaring(self, data, clear=False):
@@ -432,6 +472,7 @@ class Fragment:
             self._append_op(encode_op(
                 OP_ADD_ROARING, roaring=serialize(row_bitmap), op_n=0))
             self._invalidate_row(row_id)
+            self._mutex_vec = None  # whole-row overwrite: rebuild lazily
             self._cache_update(row_id)
             return True
 
@@ -481,6 +522,7 @@ class Fragment:
     def _invalidate_all_rows(self):
         self._row_cache.clear()
         self._checksums.clear()
+        self._mutex_vec = None  # bulk mutation: rebuild lazily
         self.generation += 1
 
     # -- anti-entropy blocks (reference: Blocks fragment.go:1778) -------------

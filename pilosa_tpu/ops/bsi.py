@@ -96,22 +96,14 @@ def compare_unsigned(planes, pbits):
 
 
 @jax.jit
-def range_eq(planes, sign, exists, pbits, neg_predicate):
-    """Columns whose signed value == predicate. `neg_predicate` is a traced
-    bool scalar selecting the sign slice (reference: rangeEQ fragment.go:1292)."""
+def _range_eq_jnp(planes, sign, exists, pbits, neg_predicate):
     base = jnp.where(neg_predicate, exists & sign, exists & ~sign)
     _, eq, _ = compare_unsigned(planes, pbits)
     return base & eq
 
 
 @jax.jit
-def range_lt(planes, sign, exists, pbits, neg_predicate, allow_eq):
-    """Columns whose signed value < predicate (<= when allow_eq).
-
-    Sign-magnitude semantics (reference: rangeLT fragment.go:1335):
-      pred >= 0: all negatives qualify; positives compare magnitudes.
-      pred <  0: only negatives, with magnitude > |pred| (reversed order).
-    """
+def _range_lt_jnp(planes, sign, exists, pbits, neg_predicate, allow_eq):
     pos = exists & ~sign
     neg = exists & sign
     lt, eq, gt = compare_unsigned(planes, pbits)
@@ -123,9 +115,7 @@ def range_lt(planes, sign, exists, pbits, neg_predicate, allow_eq):
 
 
 @jax.jit
-def range_gt(planes, sign, exists, pbits, neg_predicate, allow_eq):
-    """Columns whose signed value > predicate (>= when allow_eq).
-    Mirror of range_lt (reference: rangeGT fragment.go:1403)."""
+def _range_gt_jnp(planes, sign, exists, pbits, neg_predicate, allow_eq):
     pos = exists & ~sign
     neg = exists & sign
     lt, eq, gt = compare_unsigned(planes, pbits)
@@ -134,6 +124,61 @@ def range_gt(planes, sign, exists, pbits, neg_predicate, allow_eq):
     pos_result = pos & (gt | (eq & eq_mask))
     neg_result = pos | (neg & (lt | (eq & eq_mask)))
     return jnp.where(neg_predicate, neg_result, pos_result)
+
+
+def _use_pallas(planes):
+    """Fused single-pass pallas kernel, under the same opt-in gate as the
+    count kernels; requires full-width planes (the kernel grids over
+    WORDS_PER_ROW blocks)."""
+    from . import pallas_kernels
+    from ..shardwidth import WORDS_PER_ROW
+
+    return (pallas_kernels.enabled()
+            and planes.ndim == 2 and planes.shape[-1] == WORDS_PER_ROW
+            # the kernel grids over fixed word blocks; narrow shard widths
+            # (PILOSA_TPU_SHARD_EXP<=17) would yield an empty grid that
+            # never writes the output — use the jnp path there
+            and WORDS_PER_ROW % pallas_kernels._BSI_BLOCK_WORDS == 0)
+
+
+def range_eq(planes, sign, exists, pbits, neg_predicate):
+    """Columns whose signed value == predicate (reference: rangeEQ
+    fragment.go:1292). Dispatches to the fused pallas kernel when opted in
+    (one HBM pass, no intermediate comparator masks)."""
+    if _use_pallas(planes):
+        from .pallas_kernels import bsi_range_mask
+
+        return bsi_range_mask("eq", planes, sign, exists, pbits,
+                              neg_predicate, False)
+    return _range_eq_jnp(planes, sign, exists, pbits, neg_predicate)
+
+
+def range_lt(planes, sign, exists, pbits, neg_predicate, allow_eq):
+    """Columns whose signed value < predicate (<= when allow_eq).
+
+    Sign-magnitude semantics (reference: rangeLT fragment.go:1335):
+      pred >= 0: all negatives qualify; positives compare magnitudes.
+      pred <  0: only negatives, with magnitude > |pred| (reversed order).
+    """
+    if _use_pallas(planes):
+        from .pallas_kernels import bsi_range_mask
+
+        return bsi_range_mask("lt", planes, sign, exists, pbits,
+                              neg_predicate, allow_eq)
+    return _range_lt_jnp(planes, sign, exists, pbits, neg_predicate,
+                         allow_eq)
+
+
+def range_gt(planes, sign, exists, pbits, neg_predicate, allow_eq):
+    """Columns whose signed value > predicate (>= when allow_eq).
+    Mirror of range_lt (reference: rangeGT fragment.go:1403)."""
+    if _use_pallas(planes):
+        from .pallas_kernels import bsi_range_mask
+
+        return bsi_range_mask("gt", planes, sign, exists, pbits,
+                              neg_predicate, allow_eq)
+    return _range_gt_jnp(planes, sign, exists, pbits, neg_predicate,
+                         allow_eq)
 
 
 @jax.jit

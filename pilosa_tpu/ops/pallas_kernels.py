@@ -33,6 +33,7 @@ __all__ = [
     "count_intersect_stack",
     "count_expr_stack",
     "topn_counts_stack",
+    "bsi_range_mask",
 ]
 
 # Rows of the [S, W] stack processed per grid step. 16 sublanes x 32768
@@ -201,6 +202,117 @@ def _topn_call(n_rows, interpret):
         return call(rows, filt)[:, 0]
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Fused BSI range compare (reference: rangeLTUnsigned fragment.go:1357-1400)
+# ---------------------------------------------------------------------------
+#
+# The jnp path (ops/bsi.py) computes the (lt, eq, gt) comparator masks with
+# a lax.scan, then combines with sign/exists in a second jitted call — XLA
+# materializes the intermediate masks between the two programs. This kernel
+# fuses the whole range op into ONE pass: each grid step streams a word
+# block of all D magnitude planes + sign + exists from HBM once, unrolls
+# the MSB-first comparator over the (static) depth with the predicate bits
+# read from SMEM, applies the sign-magnitude combine for the (static)
+# operator, and writes only the final row mask.
+
+# Words per grid step of the BSI kernel. D+2 blocks of W_BLK words must fit
+# VMEM with double buffering: 64 planes x 4 KiB x 4 B = 1 MiB per step.
+_BSI_BLOCK_WORDS = 4096
+
+
+def _bsi_range_kernel(op, allow_eq, neg_pred, depth):
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    def kernel(pbits_ref, planes_ref, sign_ref, exists_ref, out_ref):
+        _FULL = jnp.uint32(0xFFFFFFFF)  # built in-kernel: no captured consts
+        w = planes_ref.shape[-1]
+        eq = jnp.full((1, w), _FULL, dtype=jnp.uint32)
+        lt = jnp.zeros((1, w), dtype=jnp.uint32)
+        gt = jnp.zeros((1, w), dtype=jnp.uint32)
+        # MSB-first unrolled comparator (zero-padded planes above the real
+        # MSB carry pbit 0 and plane 0: an exact no-op on (lt, eq, gt)).
+        for d in range(depth - 1, -1, -1):
+            plane = planes_ref[d][None, :]
+            pmask = jnp.where(pbits_ref[d] == 1, _FULL, jnp.uint32(0))
+            gt = gt | (eq & plane & ~pmask)
+            lt = lt | (eq & ~plane & pmask)
+            eq = eq & ~(plane ^ pmask)
+        sign = sign_ref[:]
+        exists = exists_ref[:]
+        pos = exists & ~sign
+        neg = exists & sign
+        eq_mask = _FULL if allow_eq else jnp.uint32(0)
+        if op == "eq":
+            base = neg if neg_pred else pos
+            out = base & eq
+        elif op == "lt":
+            # (reference: rangeLT fragment.go:1335; ops/bsi.range_lt)
+            if neg_pred:
+                out = neg & (gt | (eq & eq_mask))
+            else:
+                out = neg | (pos & (lt | (eq & eq_mask)))
+        else:  # gt (reference: rangeGT fragment.go:1403)
+            if neg_pred:
+                out = pos | (neg & (lt | (eq & eq_mask)))
+            else:
+                out = pos & (gt | (eq & eq_mask))
+        out_ref[:] = out
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=64)
+def _bsi_range_call(op, allow_eq, neg_pred, depth, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_blocks = WORDS_PER_ROW // _BSI_BLOCK_WORDS
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # pbits [depth] int32 in SMEM
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((depth, _BSI_BLOCK_WORDS), lambda i, _: (0, i)),
+            pl.BlockSpec((1, _BSI_BLOCK_WORDS), lambda i, _: (0, i)),
+            pl.BlockSpec((1, _BSI_BLOCK_WORDS), lambda i, _: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, _BSI_BLOCK_WORDS), lambda i, _: (0, i)),
+    )
+    call = pl.pallas_call(
+        _bsi_range_kernel(op, allow_eq, neg_pred, depth),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, WORDS_PER_ROW), jnp.uint32),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def run(pbits, planes, sign, exists):
+        return call(pbits, planes, sign[None, :], exists[None, :])[0]
+
+    return run
+
+
+def bsi_range_mask(op, planes, sign, exists, pbits, neg_pred, allow_eq):
+    """Fused signed BSI range compare: one HBM pass over all planes.
+
+    op: "eq" | "lt" | "gt" (NEQ composes as exists − eq at the caller,
+    matching ops/bsi.py). planes: [D, W] magnitude bit planes (LSB first);
+    sign/exists: [W]; pbits: [D] 0/1 predicate magnitude bits; neg_pred /
+    allow_eq: static Python bools. Semantics are identical to
+    ops.bsi.range_eq/range_lt/range_gt (differential-tested)."""
+    planes = jnp.asarray(planes)
+    depth = planes.shape[0]
+    pbits = jnp.asarray(pbits, dtype=jnp.int32)
+    # pad depth to a sublane multiple; zero planes with zero pbits are
+    # comparator no-ops (see kernel comment)
+    pad = (-depth) % 8
+    if pad:
+        planes = jnp.pad(planes, ((0, pad), (0, 0)))
+        pbits = jnp.pad(pbits, (0, pad))
+    run = _bsi_range_call(op, bool(allow_eq), bool(neg_pred),
+                          int(planes.shape[0]), _interpret())
+    return run(pbits, planes, jnp.asarray(sign), jnp.asarray(exists))
 
 
 def topn_counts_stack(rows, filter_plane, k):

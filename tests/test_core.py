@@ -368,3 +368,70 @@ def test_clear_bit_on_int_field_raises(holder):
     fld = idx.create_field("n", FieldOptions.int_field(min=0, max=10))
     with pytest.raises(Exception):
         fld.clear_bit(0, 1)
+
+
+def test_mutex_rows_vector_o1(tmp_path):
+    """Single mutex set_bit must be O(1), not O(rows): after the rows
+    vector is built, a write performs ZERO per-row storage scans
+    (reference keeps a rowsVector for this, fragment.go:3102). Also a
+    micro-benchmark: writes over many rows stay flat vs row count."""
+    import time
+
+    f = Fragment(str(tmp_path / "frag"), "i", "m", "standard", 0,
+                 mutexed=True).open()
+    n_rows = 300
+    for r in range(n_rows):
+        f.set_bit(r, r)  # one column per row -> n_rows rows exist
+    f.row_for_column(0)  # build the vector
+
+    scans = {"n": 0}
+    bitmap_cls = type(f.storage)
+    orig = bitmap_cls.slice_range
+
+    def counted(self, *a, **k):
+        scans["n"] += 1
+        return orig(self, *a, **k)
+
+    bitmap_cls.slice_range = counted
+    try:
+        # moves col 5 from row 5 to row 250: vector lookup + two bit
+        # flips, no row scans
+        assert f.set_bit(250, 5)
+        assert f.row_for_column(5) == 250
+        assert scans["n"] == 0, "mutex write scanned rows"
+    finally:
+        bitmap_cls.slice_range = orig
+
+    # vector survives bulk mutex import (patched, not rebuilt) and stays
+    # correct
+    f.bulk_import([7, 9], [5, 6])
+    assert f.row_for_column(5) == 7
+    assert f.row_for_column(6) == 9
+    assert not f.contains(250, 5)
+
+    # timing smoke: 200 writes with 300 rows resident finish fast (the
+    # old path probed all rows per write -> ~60k row scans)
+    t0 = time.perf_counter()
+    for i in range(200):
+        f.set_bit(i % n_rows, 1000 + i)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"mutex writes too slow: {elapsed:.2f}s"
+    f.close()
+
+
+def test_mutex_rows_vector_invalidation(tmp_path):
+    """Bulk ops invalidate the vector; reads after them are correct."""
+    f = Fragment(str(tmp_path / "frag"), "i", "m", "standard", 0,
+                 mutexed=True).open()
+    f.set_bit(1, 10)
+    assert f.row_for_column(10) == 1
+    # whole-row overwrite bypasses the mutex path entirely
+    new = np.zeros(SHARD_WIDTH // 32, dtype=np.uint32)
+    new[0] = 1 << 10
+    f.set_row_plane(2, new)
+    f.set_row_plane(1, np.zeros(SHARD_WIDTH // 32, dtype=np.uint32))
+    assert f.row_for_column(10) == 2
+    # import_roaring-style bulk positions also invalidate
+    f.import_positions([f.pos(3, 11)], [])
+    assert f.row_for_column(11) == 3
+    f.close()
