@@ -1,0 +1,325 @@
+"""BASELINE.md benchmark configs 1, 3, 4 — the regression suite beyond the
+north-star number (config 2 lives in bench.py; config 5 is the multi-node
+suite exercised by tests/test_spmd.py + tests/test_clusterproc.py).
+
+1. star_trace      — getting-started stargazer/language index, single
+                     shard: Intersect+Count correctness floor + qps.
+3. topn_groupby    — TopN + GroupBy over a 10M-column set field: the
+                     stacked [rows, shards, words] serving path.
+4. bsi_range_sum   — BSI Range conditions + filtered Sum over time-quantum
+                     views across shards: bit-plane comparators + per-plane
+                     popcount reduce.
+
+Each config prints ONE JSON line shaped like bench.py's
+({"metric", "value", "unit", "vs_baseline", "extra"}), with vs_baseline
+measured against a vectorized numpy implementation of the same queries on
+host copies of the same data. All queries run through the FULL framework
+path (Holder -> Executor -> stacked/BSI kernels), not raw kernels.
+
+Timing uses the same honest-sync discipline as bench.py: executor results
+are host ints/lists (every query materializes), so wall-clock covers
+end-to-end completion.
+
+Usage: python bench_suite.py [star_trace|topn_groupby|bsi_range_sum]
+(no arg = all three).
+"""
+
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+# Concurrent in-flight queries per measurement (a loaded server overlaps
+# independent queries; device-dispatch round trips pipeline across
+# threads, exactly as concurrent HTTP clients would drive the executor).
+WORKERS = 16
+
+
+def _measure_qps(run_one, n):
+    """qps of `run_one(i)` with WORKERS overlapping calls (end-to-end:
+    every result materializes on host before the clock stops)."""
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=WORKERS) as pool:
+        list(pool.map(run_one, range(n)))
+    return n / (time.perf_counter() - t0)
+
+
+def _dispatch_rtt_ms():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def noop(x):
+        return x + 1
+
+    s0 = jnp.int32(1)
+    int(noop(s0))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        int(noop(s0))
+        ts.append(time.perf_counter() - t0)
+    return round(float(np.percentile(ts, 50)) * 1000, 2)
+
+
+def _env():
+    import jax
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.server.api import API
+
+    platform = jax.devices()[0].platform
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="pilosa-bench-")
+    holder = Holder(tmp).open()
+    return platform, holder, API(holder), Executor(holder)
+
+
+def _emit(metric, qps, baseline_qps, extra):
+    print(json.dumps({
+        "metric": metric,
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / baseline_qps, 2) if baseline_qps else 0,
+        "extra": extra,
+    }), flush=True)
+
+
+# ---------------------------------------------------------------- config 1
+
+def bench_star_trace():
+    """Star Trace getting-started shape (reference docs: stargazer ×
+    language over one shard): Count(Intersect(Row(stargazer=u),
+    Row(language=l))) — correctness floor + single-shard qps."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    platform, holder, api, ex = _env()
+    api.create_index("startrace")
+    api.create_field("startrace", "stargazer")
+    api.create_field("startrace", "language")
+    idx = holder.index("startrace")
+
+    rng = np.random.default_rng(42)
+    n_repos = 200_000
+    stargazer = idx.field("stargazer")
+    language = idx.field("language")
+    rows, cols = [], []
+    for user in range(100):
+        n = int(rng.integers(500, 3000))
+        rows.append(np.full(n, user, dtype=np.uint64))
+        cols.append(rng.choice(n_repos, size=n, replace=False))
+    stargazer.import_bits(np.concatenate(rows), np.concatenate(cols))
+    lang_of_repo = rng.integers(0, 10, size=n_repos)
+    language.import_bits(lang_of_repo.astype(np.uint64),
+                         np.arange(n_repos, dtype=np.uint64))
+
+    # host ground truth
+    star_sets = {u: set(c.tolist()) for u, c in
+                 zip(range(100), cols)}
+    lang_sets = {l: set(np.nonzero(lang_of_repo == l)[0].tolist())
+                 for l in range(10)}
+
+    pairs = [(int(rng.integers(0, 100)), int(rng.integers(0, 10)))
+             for _ in range(30)]
+    # correctness
+    for u, l in pairs[:10]:
+        got = ex.execute(
+            "startrace",
+            f"Count(Intersect(Row(stargazer={u}), Row(language={l})))")[0]
+        want = len(star_sets[u] & lang_sets[l])
+        assert got == want, (u, l, got, want)
+
+    n_q = 120 if platform != "cpu" else 20
+
+    def one(i):
+        u, l = pairs[i % len(pairs)]
+        ex.execute(
+            "startrace",
+            f"Count(Intersect(Row(stargazer={u}), Row(language={l})))")
+
+    one(0)  # warm compiles
+    qps = _measure_qps(one, n_q)
+
+    # numpy baseline: same queries over host boolean planes
+    width = SHARD_WIDTH
+    star_planes = np.zeros((100, width // 32), dtype=np.uint32)
+    for u, c in zip(range(100), cols):
+        np.bitwise_or.at(star_planes[u], c // 32,
+                         np.uint32(1) << (c % 32).astype(np.uint32))
+    lang_planes = np.zeros((10, width // 32), dtype=np.uint32)
+    c = np.arange(n_repos)
+    for l in range(10):
+        sel = c[lang_of_repo == l]
+        np.bitwise_or.at(lang_planes[l], sel // 32,
+                         np.uint32(1) << (sel % 32).astype(np.uint32))
+    t0 = time.perf_counter()
+    for i in range(n_q):
+        u, l = pairs[i % len(pairs)]
+        int(np.sum(np.bitwise_count(star_planes[u] & lang_planes[l]),
+                   dtype=np.int64))
+    cpu_qps = n_q / (time.perf_counter() - t0)
+    rtt = _dispatch_rtt_ms()
+    holder.close()
+    _emit("star_trace_intersect_count_qps", qps, cpu_qps, {
+        "platform": platform, "n_repos": n_repos, "n_users": 100,
+        "workers": WORKERS, "dispatch_rtt_ms": rtt,
+        "cpu_baseline_qps": round(cpu_qps, 2)})
+
+
+# ---------------------------------------------------------------- config 3
+
+def bench_topn_groupby():
+    """TopN + GroupBy over a ~10M-column set field (BASELINE config 3):
+    exercises the stacked [rows, shards, words] counting path."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    platform, holder, api, ex = _env()
+    n_shards = 10 if platform != "cpu" else 3
+    n_cols = n_shards * SHARD_WIDTH
+    api.create_index("tg")
+    api.create_field("tg", "f")
+    api.create_field("tg", "a")
+    api.create_field("tg", "b")
+    idx = holder.index("tg")
+
+    rng = np.random.default_rng(7)
+    # f: 100 rows, zipf-ish sizes up to ~100k bits
+    f_rows, f_cols = [], []
+    for r in range(100):
+        n = int(100_000 / (r + 1)) + 100
+        f_rows.append(np.full(n, r, dtype=np.uint64))
+        f_cols.append(rng.integers(0, n_cols, size=n, dtype=np.uint64))
+    idx.field("f").import_bits(np.concatenate(f_rows),
+                               np.concatenate(f_cols))
+    # a (5 rows) × b (4 rows) over 300k columns for GroupBy
+    g_cols = rng.choice(n_cols, size=300_000, replace=False)
+    a_rows = rng.integers(0, 5, size=len(g_cols)).astype(np.uint64)
+    b_rows = rng.integers(0, 4, size=len(g_cols)).astype(np.uint64)
+    idx.field("a").import_bits(a_rows, g_cols.astype(np.uint64))
+    idx.field("b").import_bits(b_rows, g_cols.astype(np.uint64))
+
+    # correctness: TopN counts vs exact host counts (dedupe per row)
+    top = ex.execute("tg", "TopN(f, n=5)")[0]
+    want_counts = {r: len(set(c.tolist()))
+                   for r, c in zip(range(100), f_cols)}
+    for pair in top:
+        assert pair.count == want_counts[pair.id], pair
+
+    n_q = 40 if platform != "cpu" else 5
+    ex.execute("tg", "TopN(f, n=10)")  # warm stacks + compiles
+    topn_qps = _measure_qps(
+        lambda i: ex.execute("tg", "TopN(f, n=10)"), n_q)
+    ex.execute("tg", "GroupBy(Rows(a), Rows(b))")
+    groupby_qps = _measure_qps(
+        lambda i: ex.execute("tg", "GroupBy(Rows(a), Rows(b))"), n_q)
+
+    # numpy baseline: exact per-row popcounts over dense planes + argsort
+    planes = np.zeros((100, n_cols // 32), dtype=np.uint32)
+    for r, c in zip(range(100), f_cols):
+        np.bitwise_or.at(planes[r], c // 32,
+                         np.uint32(1) << (c % 32).astype(np.uint32))
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        counts = np.sum(np.bitwise_count(planes), axis=1, dtype=np.int64)
+        np.argsort(-counts)[:10]
+    cpu_qps = n_q / (time.perf_counter() - t0)
+    rtt = _dispatch_rtt_ms()
+    holder.close()
+    _emit("topn_groupby_10M_topn_qps", topn_qps, cpu_qps, {
+        "platform": platform, "n_cols": n_cols, "n_rows": 100,
+        "workers": WORKERS, "dispatch_rtt_ms": rtt,
+        "groupby_qps": round(groupby_qps, 2),
+        "cpu_baseline_qps": round(cpu_qps, 2)})
+
+
+# ---------------------------------------------------------------- config 4
+
+def bench_bsi_range_sum():
+    """BSI Range + filtered Sum over time-quantum views across shards
+    (BASELINE config 4): bit-plane comparators + per-plane popcount
+    reduce + time-view unions."""
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    platform, holder, api, ex = _env()
+    n_shards = 4 if platform != "cpu" else 2
+    n_cols = n_shards * SHARD_WIDTH
+    api.create_index("br")
+    api.create_field("br", "v", FieldOptions.int_field(min=0, max=1 << 20))
+    api.create_field("br", "t", FieldOptions(type="time",
+                                             time_quantum="YMD"))
+    idx = holder.index("br")
+
+    rng = np.random.default_rng(11)
+    n_vals = 400_000 if platform != "cpu" else 50_000
+    cols = rng.choice(n_cols, size=n_vals, replace=False)
+    vals = rng.integers(0, 1 << 20, size=n_vals)
+    idx.field("v").import_values(cols.astype(np.uint64), vals)
+    # time bits: one row over three months
+    from pilosa_tpu.core import timeq
+
+    month_of = rng.integers(0, 3, size=n_vals)
+    months = [timeq.parse_time(s) for s in
+              ("2019-01-15T00:00", "2019-02-15T00:00", "2019-03-15T00:00")]
+    idx.field("t").import_bits(
+        np.zeros(n_vals, dtype=np.uint64), cols.astype(np.uint64),
+        timestamps=[months[m] for m in month_of])
+
+    # correctness: range count + filtered sum vs numpy
+    thresh = 1 << 19
+    got = ex.execute("br", f"Count(Row(v > {thresh}))")[0]
+    assert got == int(np.sum(vals > thresh)), got
+    sel = month_of < 2  # Jan+Feb
+    got = ex.execute(
+        "br",
+        'Sum(Row(t=0, from="2019-01-01T00:00", to="2019-03-01T00:00"), '
+        'field=v)')[0]
+    assert got.val == int(vals[sel].sum()), got.val
+    assert got.count == int(sel.sum())
+
+    n_q = 40 if platform != "cpu" else 5
+    queries = [f"Count(Row(v > {int(t)}))"
+               for t in rng.integers(0, 1 << 20, size=8)]
+    for q in queries:
+        ex.execute("br", q)  # warm compiles
+    range_qps = _measure_qps(
+        lambda i: ex.execute("br", queries[i % len(queries)]), n_q)
+    sum_pql = ('Sum(Row(t=0, from="2019-01-01T00:00", '
+               'to="2019-03-01T00:00"), field=v)')
+    ex.execute("br", sum_pql)
+    sum_qps = _measure_qps(lambda i: ex.execute("br", sum_pql), n_q)
+
+    # numpy baseline: same range counts over the value array
+    t0 = time.perf_counter()
+    for i in range(n_q):
+        t = int(queries[i % len(queries)].split("> ")[1].split(")")[0])
+        int(np.sum(vals > t))
+    cpu_qps = n_q / (time.perf_counter() - t0)
+    rtt = _dispatch_rtt_ms()
+    holder.close()
+    _emit("bsi_range_sum_timeviews_range_qps", range_qps, cpu_qps, {
+        "platform": platform, "n_cols": n_cols, "n_vals": n_vals,
+        "workers": WORKERS, "dispatch_rtt_ms": rtt,
+        "sum_qps": round(sum_qps, 2),
+        "cpu_baseline_qps": round(cpu_qps, 2)})
+
+
+CONFIGS = {
+    "star_trace": bench_star_trace,
+    "topn_groupby": bench_topn_groupby,
+    "bsi_range_sum": bench_bsi_range_sum,
+}
+
+
+def main():
+    wanted = sys.argv[1:] or list(CONFIGS)
+    for name in wanted:
+        CONFIGS[name]()
+
+
+if __name__ == "__main__":
+    main()

@@ -216,8 +216,8 @@ class Fragment:
             self._clear_bit_locked(existing, column_id)
 
     def _mutex_vector(self):
-        """The mutex rows-vector (column offset -> row id, int32 array of
-        SHARD_WIDTH with -1 = unset, ~4 MB/fragment), built lazily with one
+        """The mutex rows-vector (column offset -> row id, int64 array of
+        SHARD_WIDTH with -1 = unset, ~8 MB/fragment), built lazily with one
         slice_range pass per row, then maintained incrementally by
         _set_bit_locked/_clear_bit_locked (bulk ops invalidate or patch
         it). O(1) lookups replace the per-write all-rows probe (reference:
@@ -226,7 +226,9 @@ class Fragment:
         and their writes don't maintain the vector."""
         vec = self._mutex_vec
         if vec is None:
-            vec = np.full(SHARD_WIDTH, -1, dtype=np.int32)
+            # int64: row ids range to ~2^44 (pos() is uint64); int32 would
+            # overflow at row >= 2^31
+            vec = np.full(SHARD_WIDTH, -1, dtype=np.int64)
             for row_id in self.row_ids():
                 base = row_id * SHARD_WIDTH
                 offs = (self.storage.slice_range(
@@ -254,8 +256,24 @@ class Fragment:
         (mutex bulk imports)."""
         with self._lock:
             if not self.mutexed:
-                return {c: r for c in column_ids
-                        if (r := self.row_for_column(int(c))) is not None}
+                # vectorized one-slice_range-per-row scan (no maintained
+                # vector on non-mutexed fragments)
+                col_by_offset = {int(c) % SHARD_WIDTH: int(c)
+                                 for c in column_ids}
+                wanted = np.array(sorted(col_by_offset), dtype=np.uint64)
+                out = {}
+                for row_id in self.row_ids():
+                    if len(wanted) == 0:
+                        break
+                    base = np.uint64(row_id * SHARD_WIDTH)
+                    offs = self.storage.slice_range(
+                        int(base), int(base) + SHARD_WIDTH) - base
+                    hits = wanted[np.isin(wanted, offs)]
+                    if len(hits):
+                        for off in hits:
+                            out[col_by_offset[int(off)]] = row_id
+                        wanted = wanted[~np.isin(wanted, hits)]
+                return out
             vec = self._mutex_vector()
             out = {}
             for c in column_ids:

@@ -1,0 +1,41 @@
+"""bench_suite.py configs stay runnable and correct (their internal
+correctness asserts are the test): BASELINE configs must not rot between
+rounds."""
+
+import json
+import io
+import sys
+
+
+def _run(config_fn, metric):
+    import bench_suite
+
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        config_fn()
+    finally:
+        sys.stdout = old
+    out = json.loads(buf.getvalue().strip())
+    assert out["metric"] == metric
+    assert out["value"] > 0
+
+
+def test_star_trace_config_runs():
+    import bench_suite
+
+    _run(bench_suite.bench_star_trace, "star_trace_intersect_count_qps")
+
+
+def test_topn_groupby_config_runs():
+    import bench_suite
+
+    _run(bench_suite.bench_topn_groupby, "topn_groupby_10M_topn_qps")
+
+
+def test_bsi_range_sum_config_runs():
+    import bench_suite
+
+    _run(bench_suite.bench_bsi_range_sum,
+         "bsi_range_sum_timeviews_range_qps")

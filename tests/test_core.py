@@ -435,3 +435,15 @@ def test_mutex_rows_vector_invalidation(tmp_path):
     f.import_positions([f.pos(3, 11)], [])
     assert f.row_for_column(11) == 3
     f.close()
+
+
+def test_mutex_rows_vector_large_row_id(tmp_path):
+    """Row ids past 2^31 must not overflow the rows-vector (int64)."""
+    f = Fragment(str(tmp_path / "frag"), "i", "m", "standard", 0,
+                 mutexed=True).open()
+    big = 1 << 31
+    f.set_bit(1, 5)
+    assert f.set_bit(big, 5)  # moves col 5 to the huge row
+    assert f.row_for_column(5) == big
+    assert not f.contains(1, 5)
+    f.close()
