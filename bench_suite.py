@@ -76,7 +76,15 @@ def _env():
 
     tmp = tempfile.mkdtemp(prefix="pilosa-bench-")
     holder = Holder(tmp).open()
+    holder._bench_tmp = tmp  # removed by _close()
     return platform, holder, API(holder), Executor(holder)
+
+
+def _close(holder):
+    import shutil
+
+    holder.close()
+    shutil.rmtree(holder._bench_tmp, ignore_errors=True)
 
 
 def _emit(metric, qps, baseline_qps, extra):
@@ -163,7 +171,7 @@ def bench_star_trace():
                    dtype=np.int64))
     cpu_qps = n_q / (time.perf_counter() - t0)
     rtt = _dispatch_rtt_ms()
-    holder.close()
+    _close(holder)
     _emit("star_trace_intersect_count_qps", qps, cpu_qps, {
         "platform": platform, "n_repos": n_repos, "n_users": 100,
         "workers": WORKERS, "dispatch_rtt_ms": rtt,
@@ -228,7 +236,7 @@ def bench_topn_groupby():
         np.argsort(-counts)[:10]
     cpu_qps = n_q / (time.perf_counter() - t0)
     rtt = _dispatch_rtt_ms()
-    holder.close()
+    _close(holder)
     _emit("topn_groupby_10M_topn_qps", topn_qps, cpu_qps, {
         "platform": platform, "n_cols": n_cols, "n_rows": 100,
         "workers": WORKERS, "dispatch_rtt_ms": rtt,
@@ -300,7 +308,7 @@ def bench_bsi_range_sum():
         int(np.sum(vals > t))
     cpu_qps = n_q / (time.perf_counter() - t0)
     rtt = _dispatch_rtt_ms()
-    holder.close()
+    _close(holder)
     _emit("bsi_range_sum_timeviews_range_qps", range_qps, cpu_qps, {
         "platform": platform, "n_cols": n_cols, "n_vals": n_vals,
         "workers": WORKERS, "dispatch_rtt_ms": rtt,
@@ -317,6 +325,10 @@ CONFIGS = {
 
 def main():
     wanted = sys.argv[1:] or list(CONFIGS)
+    unknown = [n for n in wanted if n not in CONFIGS]
+    if unknown:
+        raise SystemExit(
+            f"unknown config(s) {unknown}; valid: {' '.join(CONFIGS)}")
     for name in wanted:
         CONFIGS[name]()
 

@@ -105,11 +105,61 @@ def cmd_server(args):
     holder = Holder(data_dir, max_op_n=config.get("max-op-n")).open()
 
     # Cluster bootstrap: static host list (the JAX-distributed model —
-    # hosts known up front; reference: gossip seeds server/config.go).
+    # hosts known up front; reference: gossip seeds server/config.go), OR
+    # dynamic join (--join): discover the existing cluster from a seed
+    # node and register through the coordinator's resize flow (reference:
+    # gossip join retry gossip/gossip.go:116-140 + nodeJoin
+    # cluster.go:1796).
     cluster = None
     monitor = None
+    join_needed = False
     hosts = config.get("cluster-hosts")
-    if hosts:
+    join_target = getattr(args, "join", None) or config.get("join")
+    if join_target and hosts:
+        raise SystemExit("--join and --cluster-hosts are mutually exclusive")
+    if join_target:
+        from .cluster import Cluster, HealthMonitor, Node
+        from .server import Client
+
+        seed_uri = join_target if join_target.startswith("http") \
+            else f"http://{join_target}"
+        status = None
+        last = None
+        for _ in range(30):  # the seed may still be booting
+            try:
+                status = Client(seed_uri, timeout=5).status()
+                break
+            except Exception as e:
+                last = e
+                time.sleep(1.0)
+        if status is None:
+            raise SystemExit(
+                f"cannot reach join target {join_target}: {last}")
+        if any(not isinstance(d.get("uri"), str)
+               for d in status.get("nodes", [])):
+            raise SystemExit(
+                f"join target {join_target} is not clustered "
+                "(started without --cluster-hosts)")
+        local_id = config.get("node-id") or config["bind"]
+        if local_id.startswith("http"):
+            local_id = local_id.split("//", 1)[1]
+        join_host = local_id.rsplit(":", 1)[0]
+        if join_host in ("0.0.0.0", "::", "") or ":" not in local_id:
+            raise SystemExit(
+                "--join registers this node's id as its reachable URI; "
+                f"{local_id!r} is not reachable — pass --node-id "
+                "host:port with a routable host")
+        nodes = [Node.from_json(d) for d in status["nodes"]]
+        cluster = Cluster(
+            nodes=nodes, local_id=local_id,
+            replica_n=int(status.get("replicaN", 1)), path=data_dir)
+        # a restarted member already has itself in the saved topology;
+        # a first-time joiner must register once the server is listening
+        cluster.load_topology()
+        join_needed = cluster.node(local_id) is None
+        cluster.save_topology()
+        monitor = HealthMonitor(cluster, Client).start()
+    elif hosts:
         from .cluster import Cluster, HealthMonitor, Node
         from .server import Client
 
@@ -198,6 +248,50 @@ def cmd_server(args):
         or tls_cfg.get("certificate"),
         tls_key=getattr(args, "tls_key", None) or tls_cfg.get("key"))
     server.start()
+    if join_needed:
+        # Register with the coordinator now that we can serve the resize
+        # instruction (schema + streamed fragments land over HTTP). Retries
+        # cover a busy coordinator (resize already in progress) — the
+        # reference's join loop does the same (gossip.go:116-140).
+        import threading as _threading
+
+        def _join():
+            from .cluster import Node as _JNode
+            from .server import Client as _JClient
+
+            own_uri = f"http://{cluster.local_id}"
+            for attempt in range(60):
+                coord = cluster.coordinator
+                if coord is not None:
+                    try:
+                        _JClient(coord.uri).resize_add_node(
+                            cluster.local_id, own_uri)
+                        print(f"joined cluster via {coord.id}", flush=True)
+                        return
+                    except Exception as e:
+                        if "already in cluster" in str(e):
+                            return
+                # coordinatorship may have moved since the status
+                # snapshot: refresh membership from any live node
+                if attempt % 5 == 4:
+                    for peer in list(cluster.nodes):
+                        try:
+                            st = _JClient(peer.uri, timeout=5).status()
+                            cluster.nodes = sorted(
+                                (_JNode.from_json(d)
+                                 for d in st["nodes"]),
+                                key=lambda n: n.id)
+                            break
+                        except Exception:
+                            continue
+                time.sleep(2.0)
+            print("ERROR: cluster join did not complete after 120s — "
+                  "this node is serving OUTSIDE the cluster (owns no "
+                  "shards; writes here are invisible to members). Retry "
+                  "by restarting with --join.", flush=True)
+
+        _threading.Thread(target=_join, daemon=True,
+                          name="cluster-join").start()
     extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
           f"(data: {data_dir}{extra})", flush=True)
@@ -489,6 +583,11 @@ def main(argv=None):
                         "nodes (static bootstrap); omit for single-node")
     p.add_argument("--node-id", default=None,
                    help="this node's id (defaults to host:port of --bind)")
+    p.add_argument("--join", default=None,
+                   help="host:port of ANY existing cluster node: discover "
+                        "the cluster from it and join dynamically via the "
+                        "coordinator's resize flow (mutually exclusive "
+                        "with --cluster-hosts)")
     p.add_argument("--replicas", type=int, default=None,
                    help="replication factor (default 1)")
     p.add_argument("--spmd", action="store_true", default=False,

@@ -268,11 +268,11 @@ class Fragment:
                     base = np.uint64(row_id * SHARD_WIDTH)
                     offs = self.storage.slice_range(
                         int(base), int(base) + SHARD_WIDTH) - base
-                    hits = wanted[np.isin(wanted, offs)]
-                    if len(hits):
-                        for off in hits:
+                    mask = np.isin(wanted, offs)
+                    if mask.any():
+                        for off in wanted[mask]:
                             out[col_by_offset[int(off)]] = row_id
-                        wanted = wanted[~np.isin(wanted, hits)]
+                        wanted = wanted[~mask]
                 return out
             vec = self._mutex_vector()
             out = {}

@@ -62,6 +62,10 @@ class Executor:
         self.holder = holder
         self._stacked = StackedEvaluator()
 
+    def stacked_stats(self):
+        """Stack-cache observability snapshot (see StackedEvaluator)."""
+        return self._stacked.cache_stats()
+
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name, query, shards=None, options=None):

@@ -118,6 +118,11 @@ class StackedEvaluator:
         # Kernel-dispatch counter: tests assert serving dispatch counts are
         # independent of the shard count.
         self.dispatches = 0
+        # Cache observability (exported at /debug/vars "stacked"): without
+        # these, budget thrash (VERDICT r2) is invisible in production.
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def _stack_sharding(self):
         """NamedSharding over all local devices (None on a single device),
@@ -212,7 +217,9 @@ class StackedEvaluator:
             hit = pool.get(key)
             if hit is not None and hit[0] == gens:
                 pool.move_to_end(key)
+                self.hits += 1
                 return hit[1]
+            self.misses += 1
         return None
 
     def _cache_put(self, key, gens, arrays, nbytes):
@@ -231,11 +238,13 @@ class StackedEvaluator:
                 while self._rows_stack_bytes > budget and len(pool) > 1:
                     _, evicted = pool.popitem(last=False)
                     self._rows_stack_bytes -= evicted[2]
+                    self.evictions += 1
             else:
                 self._stack_bytes += nbytes
                 while self._stack_bytes > budget and len(pool) > 1:
                     _, evicted = pool.popitem(last=False)
                     self._stack_bytes -= evicted[2]
+                    self.evictions += 1
 
     def leaf_stack(self, idx, field_name, row_id, shards):
         """Cached [S, W] device stack of one row over `shards`."""
@@ -623,6 +632,22 @@ class StackedEvaluator:
         if bool(use_neg):
             mag = -mag
         return mag, combine_hi_lo(c_hi, c_lo)
+
+    def cache_stats(self):
+        """Snapshot for /debug/vars: hit rate and byte pressure reveal
+        whether the HBM budgets (MAX_STACK_BYTES / MAX_ROWS_STACK_BYTES)
+        are thrashing under the live workload."""
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "dispatches": self.dispatches,
+                "stack_bytes": self._stack_bytes,
+                "stack_entries": len(self._stacks),
+                "rows_stack_bytes": self._rows_stack_bytes,
+                "rows_stack_entries": len(self._rows_stacks),
+            }
 
     def invalidate(self):
         with self._lock:

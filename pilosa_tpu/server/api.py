@@ -589,14 +589,17 @@ class API:
 
     def status(self):
         state = "NORMAL"
+        replica_n = 1
         nodes = []
         if self.cluster is not None:
             state = self.cluster.state
+            replica_n = self.cluster.replica_n
             nodes = self.cluster.nodes_json()
         else:
             nodes = [{"id": "local", "uri": {"scheme": "http"},
                       "isCoordinator": True, "state": "READY"}]
-        return {"state": state, "nodes": nodes,
+        # replicaN lets a --join'ing node inherit the replication factor
+        return {"state": state, "nodes": nodes, "replicaN": replica_n,
                 "localShardWidth": SHARD_WIDTH}
 
     def shards_max(self):

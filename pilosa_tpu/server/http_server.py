@@ -355,11 +355,17 @@ class PilosaHTTPServer:
 
     def _get_debug_vars(self, req):
         """expvar-style JSON metrics (reference: /debug/vars route
-        http/handler.go:281)."""
+        http/handler.go:281), plus the stacked-evaluator cache gauges."""
+        import json as _json
+
         from ..utils.stats import registry_of
 
-        return RawResponse(registry_of(self.stats).expvar_json().encode(),
-                           "application/json")
+        out = _json.loads(registry_of(self.stats).expvar_json())
+        ex = getattr(self.api, "executor", None)
+        local = getattr(ex, "local", ex)  # ClusterExecutor wraps Executor
+        if hasattr(local, "stacked_stats"):
+            out["stacked"] = local.stacked_stats()
+        return RawResponse(_json.dumps(out).encode(), "application/json")
 
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
     #    profile.cpu config server/config.go) --------------------------------

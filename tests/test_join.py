@@ -1,0 +1,149 @@
+"""Dynamic membership: a node started with --join discovers the cluster
+from a seed node and registers through the coordinator's resize flow
+(reference: gossip join gossip/gossip.go:116-140 + nodeJoin
+cluster.go:1796). The static-bootstrap path (tests/test_clusterproc.py)
+stays unchanged."""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PILOSA_TPU_PROC_TESTS", "1") == "0",
+    reason="process cluster tests disabled")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(port, data_dir, extra_args):
+    log = open(os.path.join(data_dir, "server.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "--bind", f"127.0.0.1:{port}", "--data-dir", data_dir,
+         *extra_args],
+        stdout=log, stderr=subprocess.STDOUT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return proc, log
+
+
+def _wait_ready(clients, procs, logs, timeout=90):
+    deadline = time.time() + timeout
+    pending = set(range(len(clients)))
+    while pending and time.time() < deadline:
+        for i in list(pending):
+            if procs[i].poll() is not None:
+                logs[i].flush()
+                raise RuntimeError(
+                    f"node {i} exited: "
+                    + open(logs[i].name).read()[-2000:])
+            try:
+                clients[i].status()
+                pending.discard(i)
+            except Exception:
+                pass
+        time.sleep(0.5)
+    if pending:
+        raise TimeoutError(f"nodes not ready: {sorted(pending)}")
+
+
+def test_dynamic_join(tmp_path):
+    ports = _free_ports(3)
+    hosts = f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}"
+    procs, logs, dirs = [], [], []
+    try:
+        for i in range(2):
+            d = tempfile.mkdtemp(prefix="pilosa-join-")
+            dirs.append(d)
+            p, log = _spawn(ports[i], d,
+                            ["--cluster-hosts", hosts, "--replicas", "1"])
+            procs.append(p)
+            logs.append(log)
+        clients = [Client(f"http://127.0.0.1:{p}", timeout=30)
+                   for p in ports[:2]]
+        _wait_ready(clients, procs, logs)
+
+        clients[0].create_index("j")
+        clients[0].create_field("j", "f")
+        time.sleep(0.5)
+        cols = [s * SHARD_WIDTH + off for s in range(6) for off in (1, 9)]
+        clients[0].import_bits("j", "f", [1] * len(cols), cols)
+        want = len(cols)
+        assert clients[0].query("j", "Count(Row(f=1))")["results"][0] == want
+
+        # boot node 3 with --join pointing at node 0
+        d = tempfile.mkdtemp(prefix="pilosa-join-")
+        dirs.append(d)
+        p, log = _spawn(ports[2], d, ["--join", f"127.0.0.1:{ports[0]}"])
+        procs.append(p)
+        logs.append(log)
+        joiner = Client(f"http://127.0.0.1:{ports[2]}", timeout=30)
+        clients.append(joiner)
+        _wait_ready([joiner], [p], [log])
+
+        # the join resize completes: every node sees 3 members and NORMAL
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            statuses = [c.status() for c in clients]
+            if all(len(s["nodes"]) == 3 and s["state"] == "NORMAL"
+                   for s in statuses):
+                break
+            time.sleep(0.5)
+        else:
+            logs[2].flush()
+            raise AssertionError(
+                "join never converged: "
+                + str([(len(s["nodes"]), s["state"]) for s in statuses])
+                + open(logs[2].name).read()[-2000:])
+
+        # data intact and identically visible from every node, including
+        # the joiner (its owned shards were streamed to it)
+        for c in clients:
+            assert c.query("j", "Count(Row(f=1))")["results"][0] == want
+
+        # the joiner actually owns shards under the new placement
+        shard_sets = [set(c.index_shards("j").get("shards", []))
+                      for c in clients]
+        assert shard_sets[2], "joiner owns no shards after resize"
+
+        # writes routed through the joiner land and replicate
+        free_col = 7 * SHARD_WIDTH + 3
+        joiner.query("j", f"Set({free_col}, f=1)")
+        time.sleep(0.5)
+        for c in clients:
+            assert c.query("j", "Count(Row(f=1))")["results"][0] == want + 1
+    finally:
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
+        import shutil
+
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)

