@@ -153,9 +153,11 @@ def cmd_server(args):
         cluster = Cluster(
             nodes=nodes, local_id=local_id,
             replica_n=int(status.get("replicaN", 1)), path=data_dir)
-        # a restarted member already has itself in the saved topology;
-        # a first-time joiner must register once the server is listening
-        cluster.load_topology()
+        # The seed's membership is AUTHORITATIVE: a stale on-disk
+        # .topology (e.g. this node was removed while down) must not
+        # shadow it, or we'd skip re-registration and serve with a
+        # divergent ring. A restarted member appears in the seed's list
+        # and skips registration naturally.
         join_needed = cluster.node(local_id) is None
         cluster.save_topology()
         monitor = HealthMonitor(cluster, Client).start()
@@ -255,11 +257,17 @@ def cmd_server(args):
         # reference's join loop does the same (gossip.go:116-140).
         import threading as _threading
 
+        tls_cfg_join = config.get("tls", {}) if isinstance(
+            config.get("tls", {}), dict) else {}
+        own_scheme = "https" if (
+            getattr(args, "tls_certificate", None)
+            or tls_cfg_join.get("certificate")) else "http"
+
         def _join():
             from .cluster import Node as _JNode
             from .server import Client as _JClient
 
-            own_uri = f"http://{cluster.local_id}"
+            own_uri = f"{own_scheme}://{cluster.local_id}"
             for attempt in range(60):
                 coord = cluster.coordinator
                 if coord is not None:

@@ -261,14 +261,19 @@ class ClusterExecutor:
     def _map_reduce(self, idx, call, shards, opt):
         if shards is None:
             shards = self.cluster_shards(idx)
-        # SPMD data plane: coverable Count trees merge over collectives
+        # SPMD data plane: coverable Count/Sum trees merge over collectives
         # (cluster/spmd.py); anything it declines falls through to the
         # HTTP merge below.
-        if self.spmd is not None and call.name == "Count" \
-                and len(call.children) == 1:
-            result = self.spmd.try_count(idx, call.children[0], shards)
-            if result is not None:
-                return result
+        if self.spmd is not None:
+            if call.name == "Count" and len(call.children) == 1:
+                result = self.spmd.try_count(idx, call.children[0], shards)
+                if result is not None:
+                    return result
+            elif call.name == "Sum":
+                result = self.spmd.try_sum(idx, call, shards)
+                if result is not None:
+                    value, count = result
+                    return ValCount(value, count)
         by_node = self.cluster.shards_by_node(idx.name, shards)
 
         lock = threading.Lock()

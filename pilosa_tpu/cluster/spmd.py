@@ -89,7 +89,7 @@ class SpmdDataPlane:
 
     # -- mesh ----------------------------------------------------------------
 
-    def _global_sharding(self):
+    def _global_sharding(self, shard_axis=0, ndim=2):
         """NamedSharding over the GLOBAL device list, process-major, so
         each process's addressable block is contiguous along the shard
         axis (what make_array_from_process_local_data fills)."""
@@ -101,8 +101,10 @@ class SpmdDataPlane:
             self._mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
         import jax
 
+        spec = [None] * ndim
+        spec[shard_axis] = "shards"
         return jax.sharding.NamedSharding(
-            self._mesh, jax.sharding.PartitionSpec("shards"))
+            self._mesh, jax.sharding.PartitionSpec(*spec))
 
     def _local_device_count(self):
         import jax
@@ -142,9 +144,9 @@ class SpmdDataPlane:
 
     # -- coordinator entry ---------------------------------------------------
 
-    def try_count(self, idx, call, shards):
-        """Count(call) merged over the global mesh, or None to fall back
-        to the HTTP merge path."""
+    def _gate(self, idx, shards):
+        """Common SPMD eligibility gates; returns a step skeleton (shard
+        segments + padding) or None to fall back to the HTTP merge."""
         cluster = self.cluster
         if cluster is None or len(cluster.nodes) < 2:
             return None
@@ -157,8 +159,6 @@ class SpmdDataPlane:
             return None  # a hung participant would stall the collective
         if tuple(sorted(n.id for n in cluster.nodes)) != self._boot_node_ids:
             return None  # membership changed since jax.distributed init
-        if self._signature(idx, call) is None:
-            return None
 
         by_node = cluster.shards_by_node(idx.name, list(shards))
         segments = {node.id: sorted(s) for node, s in by_node.items()}
@@ -167,23 +167,16 @@ class SpmdDataPlane:
         dev_pp = self._local_device_count()
         longest = max((len(s) for s in segments.values()), default=0)
         seg_len = max(dev_pp, ((longest + dev_pp - 1) // dev_pp) * dev_pp)
-
-        step = {
+        return {
             "index": idx.name,
-            "pql": call_to_pql(call),
             "segments": segments,
             "seg_len": seg_len,
             "dev_pp": dev_pp,
             "nodes": list(self._boot_node_ids),
         }
 
-        # Pre-flight: every peer must confirm it can execute this step
-        # (spmd enabled, schema in sync, matching device count) with a
-        # short deadline, BEFORE anyone enters the collective — a peer
-        # that never joins would stall the whole mesh with no way out.
-        if not self._validate_on_peers(step):
-            return None
-
+    def _execute_step(self, step):
+        """Announce + run one validated step (coordinator side)."""
         with self._lock:
             self._step_id += 1
             step["step"] = self._step_id
@@ -198,7 +191,7 @@ class SpmdDataPlane:
                     errors.append((node.id, e))
 
             threads = [threading.Thread(target=post, args=(n,))
-                       for n in cluster.peers()]
+                       for n in self.cluster.peers()]
             for t in threads:
                 t.start()
             # join the collective ourselves — peers are inside run_step now
@@ -215,17 +208,67 @@ class SpmdDataPlane:
                   f"{errors}", file=sys.stderr)
         return result
 
+    def try_count(self, idx, call, shards):
+        """Count(call) merged over the global mesh, or None to fall back
+        to the HTTP merge path."""
+        if self._signature(idx, call) is None:
+            return None
+        step = self._gate(idx, shards)
+        if step is None:
+            return None
+        step["kind"] = "count"
+        step["pql"] = call_to_pql(call)
+        # Pre-flight: every peer must confirm it can execute this step
+        # (spmd enabled, schema in sync, matching device count) with a
+        # short deadline, BEFORE anyone enters the collective — a peer
+        # that never joins would stall the whole mesh with no way out.
+        if self._validate_on_peers(step) is None:
+            return None
+        return self._execute_step(step)
+
+    def try_sum(self, idx, call, shards):
+        """Sum(filter?, field=f) merged over the global mesh: the BSI
+        bit planes form [depth, shards, words] globally-sharded arrays and
+        the per-plane popcounts all-reduce over the fabric. Returns the
+        final (value, count) with the field base applied (field.go:1583),
+        or None to fall back."""
+        field_name = call.args.get("field") or call.args.get("_field")             or call.field_arg()
+        field = idx.field(field_name) if field_name else None
+        if field is None or field.options.type != "int":
+            return None
+        filter_call = call.children[0] if call.children else None
+        if filter_call is not None                 and self._signature(idx, filter_call) is None:
+            return None
+        step = self._gate(idx, shards)
+        if step is None:
+            return None
+        step["kind"] = "sum"
+        step["field"] = field.name
+        step["pql"] = call_to_pql(filter_call) if filter_call else ""
+        resps = self._validate_on_peers(step)
+        if resps is None:
+            return None
+        # depth can differ per node (it grows with out-of-range writes);
+        # the step uses the cluster-wide max, peers zero-extend
+        step["depth"] = max(
+            [field.options.bit_depth]
+            + [int(r.get("bit_depth", 0)) for r in resps])
+        result = self._execute_step(step)
+        total, count = result
+        return total + field.options.base * count, count
+
     def _validate_on_peers(self, step):
-        oks = []
+        """Pre-flight every peer; returns the list of OK responses, or
+        None when any peer declined/was unreachable."""
+        resps = []
 
         def probe(node):
             try:
                 client = self.client_factory(node.uri)
                 client.timeout = self.VALIDATE_TIMEOUT
-                resp = client.spmd_validate(step)
-                oks.append(bool(resp.get("ok")))
+                resps.append(client.spmd_validate(step))
             except Exception:
-                oks.append(False)
+                resps.append({"ok": False})
 
         threads = [threading.Thread(target=probe, args=(n,))
                    for n in self.cluster.peers()]
@@ -233,20 +276,35 @@ class SpmdDataPlane:
             t.start()
         for t in threads:
             t.join()
-        return all(oks) and len(oks) == len(self.cluster.peers())
+        if len(resps) != len(self.cluster.peers())                 or not all(r.get("ok") for r in resps):
+            return None
+        return resps
 
     def validate(self, step):
-        """Peer-side pre-flight check (POST /internal/spmd/validate)."""
+        """Peer-side pre-flight check (POST /internal/spmd/validate).
+        For kind="sum" the response carries this node's bit_depth — depth
+        can grow locally past the declared range (field.set_value), so the
+        coordinator takes the max over all nodes for the step."""
         idx = self.holder.index(step["index"])
         if idx is None:
             return {"ok": False, "reason": "index not found"}
-        if self._signature(idx, parse(step["pql"]).calls[0]) is None:
-            return {"ok": False, "reason": "tree not coverable"}
         if int(step["dev_pp"]) != self._local_device_count():
             return {"ok": False, "reason": "device count mismatch"}
         if tuple(step.get("nodes", ())) != self._boot_node_ids:
             return {"ok": False, "reason": "membership mismatch"}
-        return {"ok": True}
+        out = {"ok": True}
+        if step.get("kind", "count") == "sum":
+            field = idx.field(step["field"])
+            if field is None or field.options.type != "int":
+                return {"ok": False, "reason": "not an int field"}
+            out["bit_depth"] = field.options.bit_depth
+            if step["pql"] and self._signature(
+                    idx, parse(step["pql"]).calls[0]) is None:
+                return {"ok": False, "reason": "filter not coverable"}
+        else:
+            if self._signature(idx, parse(step["pql"]).calls[0]) is None:
+                return {"ok": False, "reason": "tree not coverable"}
+        return out
 
     # -- step execution (every process) --------------------------------------
 
@@ -256,11 +314,41 @@ class SpmdDataPlane:
             return self._run_step_locked(step)
 
     def _run_step_locked(self, step):
-        import jax
-
         idx = self.holder.index(step["index"])
         if idx is None:
             raise SpmdError(f"index not found: {step['index']}")
+        kind = step.get("kind", "count")
+        if kind == "count":
+            return self._run_count_step(idx, step)
+        if kind == "sum":
+            return self._run_sum_step(idx, step)
+        raise SpmdError(f"unknown spmd step kind: {kind}")
+
+    def _local_block(self, idx, step, field_name, row_id,
+                     view_name=None):
+        """This process's [seg_len, W] block of one row over its owned
+        shards (zero planes for shards/fragments it doesn't hold)."""
+        from ..core.view import VIEW_STANDARD
+
+        seg_len = int(step["seg_len"])
+        my_shards = step["segments"].get(self.cluster.local_id, [])
+        if len(my_shards) > seg_len:
+            raise SpmdError("segment exceeds seg_len")
+        local = np.zeros((seg_len, WORDS_PER_ROW), dtype=np.uint32)
+        field = idx.field(field_name)
+        view = field.view(view_name or VIEW_STANDARD)             if field is not None else None
+        if view is not None:
+            for j, shard in enumerate(my_shards):
+                frag = view.fragment(shard)
+                if frag is not None:
+                    plane = frag.row_plane(row_id)
+                    if plane is not None:
+                        local[j] = np.asarray(plane)
+        return local
+
+    def _run_count_step(self, idx, step):
+        import jax
+
         call = parse(step["pql"]).calls[0]
         sig_leaves = self._signature(idx, call)
         if sig_leaves is None:
@@ -268,28 +356,14 @@ class SpmdDataPlane:
                 f"step tree not coverable on this node: {step['pql']}")
         sig, leaf_keys = sig_leaves
 
-        my_shards = step["segments"].get(self.cluster.local_id, [])
-        seg_len = int(step["seg_len"])
-        if len(my_shards) > seg_len:
-            raise SpmdError("segment exceeds seg_len")
         n_proc = self._num_processes()
+        seg_len = int(step["seg_len"])
         sharding = self._global_sharding()
         global_shape = (n_proc * seg_len, WORDS_PER_ROW)
 
-        from ..core.view import VIEW_STANDARD
-
         arrays = []
         for field_name, row_id in leaf_keys:
-            local = np.zeros((seg_len, WORDS_PER_ROW), dtype=np.uint32)
-            field = idx.field(field_name)
-            view = field.view(VIEW_STANDARD) if field is not None else None
-            if view is not None:
-                for j, shard in enumerate(my_shards):
-                    frag = view.fragment(shard)
-                    if frag is not None:
-                        plane = frag.row_plane(row_id)
-                        if plane is not None:
-                            local[j] = np.asarray(plane)
+            local = self._local_block(idx, step, field_name, row_id)
             arrays.append(jax.make_array_from_process_local_data(
                 sharding, local, global_shape=global_shape))
 
@@ -299,6 +373,110 @@ class SpmdDataPlane:
         from ..ops.bitplane import combine_hi_lo
 
         return combine_hi_lo(hi, lo)
+
+    def _run_sum_step(self, idx, step):
+        """BSI Sum over globally-sharded bit planes (reference per-shard
+        algorithm: fragment.sum fragment.go:1068; the cross-node merge is
+        the all-reduce XLA inserts over the [*, shards, words] arrays)."""
+        import jax
+
+        from ..core.fragment import (
+            BSI_EXISTS_BIT,
+            BSI_OFFSET_BIT,
+            BSI_SIGN_BIT,
+        )
+        from ..ops.bitplane import combine_hi_lo
+
+        field = idx.field(step["field"])
+        if field is None:
+            raise SpmdError(f"field not found: {step['field']}")
+        depth = int(step["depth"])
+        # A write racing this step can grow the local bit_depth past the
+        # validated step depth. We still MUST enter the collective (a
+        # missing participant stalls every process), so the racing
+        # value's planes above step depth are simply not read this query
+        # — an ordinary read/write race outcome, not corruption.
+        bsi_view = field.bsi_view_name()
+
+        n_proc = self._num_processes()
+        seg_len = int(step["seg_len"])
+        plane_sh = self._global_sharding(shard_axis=1, ndim=3)
+        row_sh = self._global_sharding()
+        row_shape = (n_proc * seg_len, WORDS_PER_ROW)
+
+        # zero-extension to the cluster-wide max depth is exact: absent
+        # magnitude planes contribute 0 to every popcount
+        local_planes = np.stack([
+            self._local_block(idx, step, step["field"],
+                              BSI_OFFSET_BIT + i, view_name=bsi_view)
+            for i in range(depth)])
+        planes = jax.make_array_from_process_local_data(
+            plane_sh, local_planes,
+            global_shape=(depth,) + row_shape)
+        sign = jax.make_array_from_process_local_data(
+            row_sh, self._local_block(idx, step, step["field"],
+                                      BSI_SIGN_BIT, view_name=bsi_view),
+            global_shape=row_shape)
+        exists = jax.make_array_from_process_local_data(
+            row_sh, self._local_block(idx, step, step["field"],
+                                      BSI_EXISTS_BIT, view_name=bsi_view),
+            global_shape=row_shape)
+
+        sig = None
+        stacks = []
+        if step["pql"]:
+            sig_leaves = self._signature(idx, parse(step["pql"]).calls[0])
+            if sig_leaves is None:
+                raise SpmdError("filter not coverable on this node")
+            sig, leaf_keys = sig_leaves
+            for field_name, row_id in leaf_keys:
+                stacks.append(jax.make_array_from_process_local_data(
+                    row_sh,
+                    self._local_block(idx, step, field_name, row_id),
+                    global_shape=row_shape))
+
+        fn = self._sum_fn(sig, len(stacks))
+        res = [np.asarray(r) for r in fn(planes, sign, exists, *stacks)]
+        p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = res
+        total = 0
+        for i in range(depth):
+            total += combine_hi_lo(p_hi[i], p_lo[i]) << i
+            total -= combine_hi_lo(n_hi[i], n_lo[i]) << i
+        self.steps_run += 1
+        return total, combine_hi_lo(c_hi, c_lo)
+
+    def _sum_fn(self, sig, arity):
+        """(planes [D,S,W], sign, exists, *filter leaves) -> per-plane
+        pos/neg popcounts + consider count as (hi, lo) int32 pairs, with
+        XLA inserting the cross-process reduce."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..exec.stacked import StackedEvaluator
+        from ..ops.bitplane import hi_lo
+
+        key = ("sum", sig, arity)
+        fn = self._fns.get(key)
+        if fn is None:
+            @jax.jit
+            def fn(planes, sign, exists, *stacks):
+                consider = exists
+                if sig is not None:
+                    consider = consider & StackedEvaluator._tree_eval(
+                        sig, stacks)
+                pos = consider & ~sign
+                neg = consider & sign
+                pc = jnp.sum(jax.lax.population_count(
+                    planes & pos[None]).astype(jnp.int32), axis=-1)
+                nc = jnp.sum(jax.lax.population_count(
+                    planes & neg[None]).astype(jnp.int32), axis=-1)
+                cc = jnp.sum(jax.lax.population_count(
+                    consider).astype(jnp.int32), axis=-1)
+                return (*hi_lo(pc, axis=-1), *hi_lo(nc, axis=-1),
+                        *hi_lo(cc))
+
+            self._fns[key] = fn
+        return fn
 
     def _count_fn(self, sig, arity):
         import jax

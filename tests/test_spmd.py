@@ -174,3 +174,29 @@ def test_row_results_still_http(cluster):
     time.sleep(0.2)
     got = cluster.clients[0].query("sp", "Row(f=42)")["results"][0]
     assert sorted(got["columns"]) == sorted(cols)
+
+
+def test_sum_merges_via_collective(cluster):
+    """BSI Sum rides the SPMD data plane: globally-sharded bit planes,
+    per-plane popcounts all-reduced over the fabric."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "v", options={"type": "int",
+                                           "min": -1000, "max": 1000})
+    time.sleep(1.0)  # DDL broadcast settles
+    cols = [s * SHARD_WIDTH + off for s in range(6) for off in (2, 33)]
+    vals = [((i * 37) % 2001) - 1000 for i in range(len(cols))]
+    coord.import_values("sp", "v", cols, vals)
+
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "Sum(field=v)")["results"][0]
+    assert got == {"value": sum(vals), "count": len(vals)}
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+    # filtered Sum (coverable filter) also rides the collective
+    coord.import_bits("sp", "f", [77] * (len(cols) // 2), cols[::2])
+    before = after
+    got = coord.query("sp", "Sum(Row(f=77), field=v)")["results"][0]
+    assert got == {"value": sum(vals[::2]), "count": len(cols[::2])}
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
