@@ -148,7 +148,11 @@ def finalize_result(call, result):
             and isinstance(result[0], GroupCount):
         limit = call.args.get("limit")
         if limit is not None:
-            return result[:int(limit)]
+            result = result[:int(limit)]
+        offset = call.args.get("offset")
+        if offset is not None and int(offset) < len(result):
+            result = result[int(offset):]
+        return result
     if isinstance(result, RowIdentifiers):
         limit = call.args.get("limit")
         if limit is not None and result.keys is None:

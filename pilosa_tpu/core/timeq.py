@@ -145,3 +145,53 @@ def views_by_time_range(name, start, end, quantum):
             break
 
     return results
+
+
+def view_time_part(view_name, base):
+    """The trailing time digits of a quantum view name ('' if none)."""
+    if not view_name.startswith(base + "_"):
+        return ""
+    part = view_name[len(base) + 1:]
+    return part if part.isdigit() else ""
+
+
+def min_max_views(view_names, quantum, base):
+    """(min, max) view names among `view_names` at the quantum's COARSEST
+    unit (reference: minMaxViews time.go:240 — 4 chars for Y, 6 for M,
+    8 for D, 10 for H). (None, None) when no time views exist."""
+    if "Y" in quantum:
+        chars = 4
+    elif "M" in quantum:
+        chars = 6
+    elif "D" in quantum:
+        chars = 8
+    elif "H" in quantum:
+        chars = 10
+    else:
+        return None, None
+    matching = sorted(
+        v for v in view_names if len(view_time_part(v, base)) == chars)
+    if not matching:
+        return None, None
+    return matching[0], matching[-1]
+
+
+def time_of_view(view_name, base, adj=False):
+    """The start time a quantum view covers; with adj=True, the end
+    (start of the NEXT unit) — reference: timeOfView time.go:279."""
+    part = view_time_part(view_name, base)
+    fmts = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}
+    fmt = fmts.get(len(part))
+    if fmt is None:
+        raise ValueError(f"not a time view: {view_name!r}")
+    t = dt.datetime.strptime(part, fmt)
+    if adj:
+        if len(part) == 4:
+            t = t.replace(year=t.year + 1)
+        elif len(part) == 6:
+            t = _add_month(t)
+        elif len(part) == 8:
+            t = t + dt.timedelta(days=1)
+        else:
+            t = t + dt.timedelta(hours=1)
+    return t

@@ -880,6 +880,37 @@ class Executor:
 
     # ---------------------------------------------------------------- Rows
 
+    def _rows_views(self, field, call):
+        """View names Rows() inspects: the standard view, or for a time
+        field with from/to (or noStandardView) the minimal quantum-view
+        cover of the range, clamped to the views that actually exist
+        (reference: executeRowsShard executor.go:1338-1400 +
+        minMaxViews/timeOfView time.go:240-340)."""
+        if field.type != FIELD_TYPE_TIME:
+            return [VIEW_STANDARD]
+        from_t = timeq.parse_time(call.args["from"]) \
+            if "from" in call.args else None
+        to_t = timeq.parse_time(call.args["to"]) \
+            if "to" in call.args else None
+        if from_t is None and to_t is None \
+                and not field.options.no_standard_view:
+            return [VIEW_STANDARD]
+        quantum = field.time_quantum()
+        if not quantum:
+            return []
+        vmin, vmax = timeq.min_max_views(
+            list(field.views), quantum, VIEW_STANDARD)
+        if vmin is None:
+            return []
+        min_t = timeq.time_of_view(vmin, VIEW_STANDARD)
+        max_t = timeq.time_of_view(vmax, VIEW_STANDARD, adj=True)
+        if from_t is None or from_t < min_t:
+            from_t = min_t
+        if to_t is None or to_t > max_t:
+            to_t = max_t
+        return timeq.views_by_time_range(
+            VIEW_STANDARD, from_t, to_t, quantum)
+
     def _exec_rows(self, idx, call, shards, opt):
         """(reference: executeRows executor.go:1280)"""
         field = self._set_field(idx, call)
@@ -888,19 +919,23 @@ class Executor:
         column = call.args.get("column")
 
         rows = set()
-        for shard in self._call_shards(idx, shards):
-            view = field.view(VIEW_STANDARD)
-            frag = view.fragment(shard) if view else None
-            if frag is None:
+        shard_list = self._call_shards(idx, shards)
+        for view_name in self._rows_views(field, call):
+            view = field.view(view_name)
+            if view is None:
                 continue
-            if column is not None:
-                if int(column) // SHARD_WIDTH != shard:
+            for shard in shard_list:
+                frag = view.fragment(shard)
+                if frag is None:
                     continue
-                for r in frag.row_ids():
-                    if frag.contains(r, int(column)):
-                        rows.add(r)
-            else:
-                rows.update(frag.row_ids())
+                if column is not None:
+                    if int(column) // SHARD_WIDTH != shard:
+                        continue
+                    for r in frag.row_ids():
+                        if frag.contains(r, int(column)):
+                            rows.add(r)
+                else:
+                    rows.update(frag.row_ids())
         out = sorted(rows)
         if previous is not None:
             out = [r for r in out if r > int(previous)]
@@ -951,6 +986,13 @@ class Executor:
         ]
         if limit is not None and not opt.remote:
             out = out[:int(limit)]
+        # offset applies after the limit-bounded merge, and is a NO-OP
+        # when it reaches past the result set (reference guards
+        # `offset < len(results)`: executeGroupBy executor.go:1134-1143)
+        offset = call.args.get("offset")
+        if offset is not None and not opt.remote \
+                and int(offset) < len(out):
+            out = out[int(offset):]
         return out
 
     def _group_by_stacked(self, idx, fields, child_rows, filter_call,

@@ -380,3 +380,67 @@ def test_group_by_limit_applies_globally(env):
     got = e.execute("i", "GroupBy(Rows(a))")[0]
     assert got == [GroupCount([FieldRow("a", 1)], 1),
                    GroupCount([FieldRow("a", 2)], 2)]
+
+
+def test_rows_time_range(env):
+    """Rows() on a time field with from/to walks quantum views, clamped to
+    existing views (reference: executeRowsShard executor.go:1338-1400)."""
+    from pilosa_tpu.core import timeq
+
+    holder, e = env
+    idx = holder.create_index("i")
+    idx.create_field("t", FieldOptions.time_field("YMD"))
+    f = idx.field("t")
+    f.set_bit(1, 10, timestamp=timeq.parse_time("2019-01-15T00:00"))
+    f.set_bit(2, 11, timestamp=timeq.parse_time("2019-02-10T00:00"))
+    f.set_bit(3, 12, timestamp=timeq.parse_time("2019-03-05T00:00"))
+    idx.add_existence([10, 11, 12])
+
+    # full range (no args): standard view -> all rows
+    assert e.execute("i", "Rows(t)")[0].rows == [1, 2, 3]
+    # Jan..Feb only
+    got = e.execute(
+        "i", 'Rows(t, from="2019-01-01T00:00", to="2019-03-01T00:00")')[0]
+    assert got.rows == [1, 2]
+    # open-ended from: clamps to earliest existing view
+    got = e.execute("i", 'Rows(t, to="2019-02-01T00:00")')[0]
+    assert got.rows == [1]
+    # open-ended to: clamps to latest existing view
+    got = e.execute("i", 'Rows(t, from="2019-02-01T00:00")')[0]
+    assert got.rows == [2, 3]
+    # out-of-range window -> empty
+    got = e.execute(
+        "i", 'Rows(t, from="2020-01-01T00:00", to="2020-02-01T00:00")')[0]
+    assert got.rows == []
+
+
+def test_rows_time_no_standard_view(env):
+    from pilosa_tpu.core import timeq  # noqa: F401
+
+    holder, e = env
+    idx = holder.create_index("i")
+    idx.create_field(
+        "tn", FieldOptions.time_field("YM", no_standard_view=True))
+    f = idx.field("tn")
+    f.set_bit(7, 3, timestamp=timeq.parse_time("2019-05-01T00:00"))
+    # no standard view: Rows() must still answer via the time views
+    assert e.execute("i", "Rows(tn)")[0].rows == [7]
+
+
+def test_group_by_offset(env):
+    """(reference: executeGroupBy offset executor.go:1134)"""
+    h, e = env
+    idx = h.create_index("i")
+    idx.create_field("g")
+    f = idx.field("g")
+    f.import_bits([0, 1, 2, 3], [0, 1, 2, 3])
+    all_groups = e.execute("i", "GroupBy(Rows(g))")[0]
+    assert len(all_groups) == 4
+    got = e.execute("i", "GroupBy(Rows(g), offset=2)")[0]
+    assert got == all_groups[2:]
+    got = e.execute("i", "GroupBy(Rows(g), limit=3, offset=1)")[0]
+    assert got == all_groups[:3][1:]
+    # offset past the end is a NO-OP, not empty (reference guards
+    # offset < len(results))
+    got = e.execute("i", "GroupBy(Rows(g), offset=10)")[0]
+    assert got == all_groups
