@@ -283,19 +283,25 @@ class Fragment:
             return out
 
     def contains(self, row_id, column_id):
-        return self.storage.contains(self.pos(row_id, column_id))
+        with self._lock:
+            return self.storage.contains(self.pos(row_id, column_id))
 
     # -- BSI value ops (reference: fragment.go:896-1000) ---------------------
 
     def value(self, column_id, bit_depth):
         with self._lock:
-            if not self.contains(BSI_EXISTS_BIT, column_id):
+            # direct storage probes: contains() would re-acquire the
+            # RLock per bit (up to ~66 acquisitions for wide BSI fields)
+            def bit(row_id):
+                return self.storage.contains(self.pos(row_id, column_id))
+
+            if not bit(BSI_EXISTS_BIT):
                 return 0, False
             value = 0
             for i in range(bit_depth):
-                if self.contains(BSI_OFFSET_BIT + i, column_id):
+                if bit(BSI_OFFSET_BIT + i):
                     value |= 1 << i
-            if self.contains(BSI_SIGN_BIT, column_id):
+            if bit(BSI_SIGN_BIT):
                 value = -value
             return value, True
 
@@ -425,18 +431,32 @@ class Fragment:
     def row_plane(self, row_id):
         """Host dense words for one row: containers
         [row*CPS, (row+1)*CPS) (reference: rowFromStorage fragment.go:623
-        via OffsetRange)."""
-        return self.storage.dense_range_words(
-            row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD)
+        via OffsetRange). Locked: readers must never observe a container
+        mid-mutation (the reference guards reads with fragment.mu
+        RLock; the stress suite reproduces torn reads without this)."""
+        with self._lock:
+            return self.storage.dense_range_words(
+                row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD)
 
     def row_device(self, row_id):
-        """Device plane for one row, cached until the row is written."""
+        """Device plane for one row, cached until the row is written.
+
+        The device upload happens outside the lock (it can be slow), so
+        the cache insert is generation-guarded: a write that lands between
+        the snapshot and the insert invalidates the cache slot, and a
+        stale plane must not be re-inserted over that invalidation."""
         import jax.numpy as jnp
 
         cached = self._row_cache.get(row_id)
         if cached is None:
-            cached = jnp.asarray(self.row_plane(row_id))
-            self._row_cache[row_id] = cached
+            with self._lock:
+                gen = self.generation
+                plane = self.storage.dense_range_words(
+                    row_id * CONTAINERS_PER_SHARD, CONTAINERS_PER_SHARD)
+            cached = jnp.asarray(plane)
+            with self._lock:
+                if self.generation == gen:
+                    self._row_cache[row_id] = cached
         return cached
 
     def row_ids(self):
@@ -445,12 +465,14 @@ class Fragment:
         cached = self._row_ids_cache
         if cached is not None and cached[0] == self.generation:
             return cached[1]
-        ids = sorted({
-            key // CONTAINERS_PER_SHARD
-            for key in self.storage.keys()
-            if self.storage.containers[key].n > 0
-        })
-        self._row_ids_cache = (self.generation, ids)
+        with self._lock:
+            gen = self.generation
+            ids = sorted({
+                key // CONTAINERS_PER_SHARD
+                for key in self.storage.keys()
+                if self.storage.containers[key].n > 0
+            })
+            self._row_ids_cache = (gen, ids)
         return ids
 
     def max_row_id(self):
@@ -459,8 +481,9 @@ class Fragment:
 
     def row_columns(self, row_id):
         """Absolute column ids of a row (host path, for result assembly)."""
-        base = row_id * SHARD_WIDTH
-        cols = self.storage.slice_range(base, base + SHARD_WIDTH)
+        with self._lock:
+            base = row_id * SHARD_WIDTH
+            cols = self.storage.slice_range(base, base + SHARD_WIDTH)
         return (cols - np.uint64(base)) + np.uint64(self.shard * SHARD_WIDTH)
 
     def set_row_plane(self, row_id, plane_words):
@@ -566,9 +589,10 @@ class Fragment:
 
     def block_data(self, block_id):
         """(row_ids, column_ids) pairs within a block (reference: blockData)."""
-        positions = self.storage.slice_range(
-            block_id * HASH_BLOCK_SIZE * SHARD_WIDTH,
-            (block_id + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH)
+        with self._lock:
+            positions = self.storage.slice_range(
+                block_id * HASH_BLOCK_SIZE * SHARD_WIDTH,
+                (block_id + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH)
         rows = positions // np.uint64(SHARD_WIDTH)
         cols = positions % np.uint64(SHARD_WIDTH)
         return rows, cols
@@ -578,8 +602,9 @@ class Fragment:
     def row_count(self, row_id):
         """Exact bit count of one row, from container cardinalities —
         row ranges are container-aligned so no densification happens."""
-        return int(self.storage.count_range(
-            row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH))
+        with self._lock:
+            return int(self.storage.count_range(
+                row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH))
 
     def _cache_update(self, row_id):
         if self.cache is not None:
@@ -588,8 +613,9 @@ class Fragment:
     # -- stats ----------------------------------------------------------------
 
     def cardinality(self):
-        return self.storage.count()
+        with self._lock:
+            return self.storage.count()
 
     def __repr__(self):
         return (f"<Fragment {self.index}/{self.field}/{self.view}/"
-                f"{self.shard} n={self.storage.count()}>")
+                f"{self.shard} n={self.cardinality()}>")
