@@ -278,6 +278,10 @@ class ClusterExecutor:
                 if result is not None:
                     value, count = result
                     return ValCount(value, count)
+            elif call.name == "TopN":
+                result = self.spmd.try_topn(idx, call, shards)
+                if result is not None:
+                    return result
         by_node = self.cluster.shards_by_node(idx.name, shards)
 
         lock = threading.Lock()

@@ -257,6 +257,78 @@ class SpmdDataPlane:
         total, count = result
         return total + field.options.base * count, count
 
+    #: candidate-row cap for SPMD TopN: [rows, shards, words] blocks must
+    #: stay bounded per process; larger candidate sets fall back to HTTP
+    TOPN_MAX_ROWS = 4096
+
+    def try_topn(self, idx, call, shards):
+        """TopN merged over the global mesh: candidate rows are unioned
+        across nodes in the validation round, then one [rows, shards,
+        words] globally-sharded stack counts every candidate with the
+        cross-process all-reduce. Returns the final trimmed pair list
+        (reference merge: Pairs.Add cache.go:356 + executor.go:925), or
+        None to fall back (attr filters / tanimoto / oversized candidate
+        sets use the HTTP path)."""
+        field_name = call.args.get("_field") or call.field_arg()
+        field = idx.field(field_name) if field_name else None
+        if field is None or field.options.type == "int":
+            return None
+        # tanimoto needs per-row plain counts + src count; attr filters
+        # need the attr store — both stay on the HTTP/local path
+        if call.args.get("tanimotoThreshold") or                 call.args.get("attrName") is not None:
+            return None
+        if len(call.children) > 1:
+            return None
+        filter_call = call.children[0] if call.children else None
+        if filter_call is not None                 and self._signature(idx, filter_call) is None:
+            return None
+        step = self._gate(idx, shards)
+        if step is None:
+            return None
+        step["kind"] = "topn"
+        step["field"] = field.name
+        step["pql"] = call_to_pql(filter_call) if filter_call else ""
+        resps = self._validate_on_peers(step)
+        if resps is None:
+            return None
+        # global candidate set = union of every node's cache/row ids
+        rows = set(self._topn_candidates(idx, field.name))
+        for r in resps:
+            rows.update(int(x) for x in r.get("rows", []))
+        rows = sorted(rows)
+        if not rows:
+            return []
+        if len(rows) > self.TOPN_MAX_ROWS:
+            return None
+        step["rows"] = rows
+        counts = self._execute_step(step)
+
+        from ..exec.result import Pair
+
+        threshold = max(int(call.args.get("threshold") or 1), 1)
+        pairs = [Pair(r, c) for r, c in zip(rows, counts)
+                 if c >= threshold]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        n = call.args.get("n")
+        if n is not None:
+            pairs = pairs[:int(n)]
+        return pairs
+
+    def _topn_candidates(self, idx, field_name):
+        """This node's TopN candidate rows (shared policy:
+        exec.executor.fragment_topn_candidates)."""
+        from ..core.view import VIEW_STANDARD
+        from ..exec.executor import fragment_topn_candidates
+
+        field = idx.field(field_name)
+        view = field.view(VIEW_STANDARD) if field is not None else None
+        if view is None:
+            return []
+        rows = set()
+        for frag in list(view.fragments.values()):
+            rows.update(fragment_topn_candidates(frag))
+        return sorted(rows)
+
     def _validate_on_peers(self, step):
         """Pre-flight every peer; returns the list of OK responses, or
         None when any peer declined/was unreachable."""
@@ -293,7 +365,8 @@ class SpmdDataPlane:
         if tuple(step.get("nodes", ())) != self._boot_node_ids:
             return {"ok": False, "reason": "membership mismatch"}
         out = {"ok": True}
-        if step.get("kind", "count") == "sum":
+        kind = step.get("kind", "count")
+        if kind == "sum":
             field = idx.field(step["field"])
             if field is None or field.options.type != "int":
                 return {"ok": False, "reason": "not an int field"}
@@ -301,6 +374,15 @@ class SpmdDataPlane:
             if step["pql"] and self._signature(
                     idx, parse(step["pql"]).calls[0]) is None:
                 return {"ok": False, "reason": "filter not coverable"}
+        elif kind == "topn":
+            field = idx.field(step["field"])
+            if field is None or field.options.type == "int":
+                return {"ok": False, "reason": "not a set field"}
+            if step["pql"] and self._signature(
+                    idx, parse(step["pql"]).calls[0]) is None:
+                return {"ok": False, "reason": "filter not coverable"}
+            # contribute this node's candidate rows to the global union
+            out["rows"] = self._topn_candidates(idx, step["field"])
         else:
             if self._signature(idx, parse(step["pql"]).calls[0]) is None:
                 return {"ok": False, "reason": "tree not coverable"}
@@ -322,6 +404,8 @@ class SpmdDataPlane:
             return self._run_count_step(idx, step)
         if kind == "sum":
             return self._run_sum_step(idx, step)
+        if kind == "topn":
+            return self._run_topn_step(idx, step)
         raise SpmdError(f"unknown spmd step kind: {kind}")
 
     def _local_block(self, idx, step, field_name, row_id,
@@ -444,6 +528,71 @@ class SpmdDataPlane:
             total -= combine_hi_lo(n_hi[i], n_lo[i]) << i
         self.steps_run += 1
         return total, combine_hi_lo(c_hi, c_lo)
+
+    def _run_topn_step(self, idx, step):
+        """Candidate-row counts over a globally-sharded [rows, shards,
+        words] stack (reference per-shard scan: fragment.top
+        fragment.go:1570; the heap merge becomes the all-reduce)."""
+        import jax
+
+        from ..ops.bitplane import combine_hi_lo
+
+        rows = [int(r) for r in step["rows"]]
+        n_proc = self._num_processes()
+        seg_len = int(step["seg_len"])
+        rows_sh = self._global_sharding(shard_axis=1, ndim=3)
+        leaf_sh = self._global_sharding()
+        row_shape = (n_proc * seg_len, WORDS_PER_ROW)
+
+        local = np.stack([
+            self._local_block(idx, step, step["field"], r) for r in rows])
+        stack = jax.make_array_from_process_local_data(
+            rows_sh, local, global_shape=(len(rows),) + row_shape)
+
+        sig = None
+        stacks = []
+        if step["pql"]:
+            sig_leaves = self._signature(idx, parse(step["pql"]).calls[0])
+            if sig_leaves is None:
+                raise SpmdError("filter not coverable on this node")
+            sig, leaf_keys = sig_leaves
+            for field_name, row_id in leaf_keys:
+                stacks.append(jax.make_array_from_process_local_data(
+                    leaf_sh,
+                    self._local_block(idx, step, field_name, row_id),
+                    global_shape=row_shape))
+
+        fn = self._topn_fn(sig, len(stacks))
+        hi, lo = fn(stack, *stacks)
+        self.steps_run += 1
+        totals = combine_hi_lo(hi, lo)
+        return [int(t) for t in totals]
+
+    def _topn_fn(self, sig, arity):
+        """(rows [R,S,W], *filter leaves) -> per-row (hi [R], lo [R])
+        counts of row ∩ filter, all-reduced across processes."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..exec.stacked import StackedEvaluator
+        from ..ops.bitplane import hi_lo
+
+        key = ("topn", sig, arity)
+        fn = self._fns.get(key)
+        if fn is None:
+            @jax.jit
+            def fn(stack, *stacks):
+                x = stack
+                if sig is not None:
+                    filt = StackedEvaluator._tree_eval(sig, stacks)
+                    x = x & filt[None]
+                per_shard = jnp.sum(
+                    jax.lax.population_count(x).astype(jnp.int32),
+                    axis=-1)
+                return hi_lo(per_shard, axis=-1)
+
+            self._fns[key] = fn
+        return fn
 
     def _sum_fn(self, sig, arity):
         """(planes [D,S,W], sign, exists, *filter leaves) -> per-plane

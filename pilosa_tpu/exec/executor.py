@@ -52,6 +52,15 @@ class ExecOptions:
         self.profile = profile
 
 
+def fragment_topn_candidates(frag, use_cache=True):
+    """THE per-fragment TopN candidate policy: cache ids when a cache is
+    populated (the reference's approximation), else every present row.
+    Shared by the local executor and the SPMD data plane."""
+    if use_cache and frag.cache is not None and len(frag.cache):
+        return frag.cache.ids()
+    return frag.row_ids()
+
+
 class Executor:
     """Single-node executor over a Holder. The cluster layer (parallel/)
     wraps this with shard->node fan-out."""
@@ -799,10 +808,7 @@ class Executor:
             frag = view.fragment(shard)
             if frag is None:
                 continue
-            if use_cache and frag.cache is not None and len(frag.cache):
-                rows.update(frag.cache.ids())
-            else:
-                rows.update(frag.row_ids())
+            rows.update(fragment_topn_candidates(frag, use_cache))
         if restrict_ids is not None:
             wanted = {int(r) for r in restrict_ids}
             rows &= wanted

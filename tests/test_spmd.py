@@ -200,3 +200,36 @@ def test_sum_merges_via_collective(cluster):
     assert got == {"value": sum(vals[::2]), "count": len(cols[::2])}
     after = _spmd_steps(cluster)
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+
+def test_topn_merges_via_collective(cluster):
+    """TopN rides the SPMD data plane: candidate rows from every node's
+    caches union in the validation round, counts all-reduce over one
+    [rows, shards, words] globally-sharded stack."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "tf")
+    time.sleep(1.0)
+    # row 1: 12 cols, row 2: 6 cols, row 3: 2 cols across 6 shards
+    rows, cols = [], []
+    for s in range(6):
+        rows += [1, 1, 2]
+        cols += [s * SHARD_WIDTH + 1, s * SHARD_WIDTH + 2,
+                 s * SHARD_WIDTH + 3]
+    rows += [3, 3]
+    cols += [5, SHARD_WIDTH + 5]
+    coord.import_bits("sp", "tf", rows, cols)
+
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "TopN(tf, n=2)")["results"][0]
+    assert got == [{"id": 1, "count": 12}, {"id": 2, "count": 6}]
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+    # filtered TopN (coverable source row) also rides the collective
+    coord.import_bits("sp", "g", [9] * 6,
+                      [s * SHARD_WIDTH + 1 for s in range(6)])
+    before = after
+    got = coord.query("sp", "TopN(tf, Row(g=9), n=3)")["results"][0]
+    assert got == [{"id": 1, "count": 6}]
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
