@@ -195,7 +195,10 @@ def cmd_server(args):
         from .cluster.spmd import SpmdDataPlane
         from .server import Client as _SpmdClient
 
-        spmd = SpmdDataPlane(holder, cluster, _SpmdClient)
+        from .utils.logger import StandardLogger
+
+        spmd = SpmdDataPlane(holder, cluster, _SpmdClient,
+                             logger=StandardLogger())
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
               spmd=spmd)

@@ -149,6 +149,12 @@ def finalize_result(call, result):
         limit = call.args.get("limit")
         if limit is not None:
             result = result[:int(limit)]
+        # offset applies AFTER the limit-bounded merge and is a NO-OP when
+        # it reaches past the result set — this matches the reference's
+        # effective behavior (`offset < len(results)` guard after the
+        # limit-bounded merge, executeGroupBy executor.go:1134-1149), NOT
+        # SQL's offset-then-limit; keep in sync with the local-executor
+        # copy (exec/executor.py _exec_group_by).
         offset = call.args.get("offset")
         if offset is not None and int(offset) < len(result):
             result = result[int(offset):]
@@ -168,11 +174,15 @@ class ClusterExecutor:
     Wraps exec.Executor. With a single-node cluster (or none) it degrades
     to purely local execution."""
 
-    def __init__(self, holder, cluster, client_factory, spmd=None):
+    def __init__(self, holder, cluster, client_factory, spmd=None,
+                 logger=None):
+        from ..utils.logger import NopLogger
+
         self.holder = holder
         self.cluster = cluster
         self.client_factory = client_factory
         self.spmd = spmd
+        self.logger = logger or NopLogger()
         self.local = Executor(holder)
 
     # -- public entry --------------------------------------------------------
@@ -256,8 +266,12 @@ class ClusterExecutor:
         for node in self.cluster.peers():
             try:
                 self._client(node).query(idx.name, pql, remote=True)
-            except Exception:
-                pass  # attr divergence heals via anti-entropy attr diff
+            except Exception as e:
+                # replica divergence heals via the anti-entropy attr diff,
+                # but an operator must be able to SEE it happened
+                self.logger.printf(
+                    "attr write %s diverged on %s (anti-entropy will "
+                    "repair): %s", call.name, node.id, e)
         return result
 
     # -- mapReduce -----------------------------------------------------------
@@ -265,23 +279,14 @@ class ClusterExecutor:
     def _map_reduce(self, idx, call, shards, opt):
         if shards is None:
             shards = self.cluster_shards(idx)
-        # SPMD data plane: coverable Count/Sum trees merge over collectives
-        # (cluster/spmd.py); anything it declines falls through to the
-        # HTTP merge below.
+        # SPMD data plane: coverable Count/Sum/Min/Max/TopN/GroupBy trees
+        # merge over collectives (cluster/spmd.py), initiated from any
+        # node (non-coordinators forward in one hop); anything it declines
+        # falls through to the HTTP merge below.
         if self.spmd is not None:
-            if call.name == "Count" and len(call.children) == 1:
-                result = self.spmd.try_count(idx, call.children[0], shards)
-                if result is not None:
-                    return result
-            elif call.name == "Sum":
-                result = self.spmd.try_sum(idx, call, shards)
-                if result is not None:
-                    value, count = result
-                    return ValCount(value, count)
-            elif call.name == "TopN":
-                result = self.spmd.try_topn(idx, call, shards)
-                if result is not None:
-                    return result
+            used, result = self.spmd.maybe_execute(idx, call, shards)
+            if used:
+                return result
         by_node = self.cluster.shards_by_node(idx.name, shards)
 
         lock = threading.Lock()

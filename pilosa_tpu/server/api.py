@@ -126,7 +126,7 @@ class API:
             from ..cluster import ResizeManager
 
             self.executor = ClusterExecutor(holder, cluster, client_factory,
-                                            spmd=spmd)
+                                            spmd=spmd, logger=self.logger)
             self.resize = ResizeManager(holder, cluster, self.client_factory)
         else:
             self.executor = Executor(holder)

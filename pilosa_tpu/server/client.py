@@ -186,6 +186,15 @@ class Client:
             "POST", "/internal/spmd/validate", _json.dumps(step).encode(),
             content_type="application/json")
 
+    def spmd_initiate(self, payload):
+        """Forward an eligible call to the coordinator for collective step
+        initiation (non-coordinator one-hop path)."""
+        import json as _json
+
+        return self._request(
+            "POST", "/internal/spmd/initiate", _json.dumps(payload).encode(),
+            content_type="application/json")
+
     def shard_fragments(self, index, shard):
         """(field, view) fragments a node holds for one shard (resize
         streaming discovery)."""

@@ -84,6 +84,8 @@ class PilosaHTTPServer:
             Route("POST", r"/internal/spmd/step", self._post_spmd_step),
             Route("POST", r"/internal/spmd/validate",
                   self._post_spmd_validate),
+            Route("POST", r"/internal/spmd/initiate",
+                  self._post_spmd_initiate),
             Route("GET", r"/internal/spmd/stats", self._get_spmd_stats),
             Route("GET", r"/internal/fragment/blocks",
                   self._get_fragment_blocks),
@@ -278,6 +280,15 @@ class PilosaHTTPServer:
         if self.api.spmd is None:
             return {"ok": False, "reason": "spmd mode not enabled"}
         return self.api.spmd.validate(_json.loads(req.body.decode()))
+
+    def _post_spmd_initiate(self, req):
+        """Non-coordinator nodes forward eligible calls here for collective
+        step initiation (the coordinator is the single step initiator)."""
+        import json as _json
+
+        if self.api.spmd is None:
+            return {"used": False}
+        return self.api.spmd.initiate(_json.loads(req.body.decode()))
 
     def _get_spmd_stats(self, req):
         if self.api.spmd is None:

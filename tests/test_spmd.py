@@ -149,22 +149,57 @@ def test_count_merges_via_collective(cluster):
     assert all(a - b == 2 for a, b in zip(after, before)), (before, after)
 
 
-def test_non_coordinator_and_uncoverable_fall_back(cluster):
+def test_non_coordinator_initiates_via_forward(cluster):
+    """A query POSTed to a NON-coordinator node still rides the collective:
+    the node forwards the eligible call to the coordinator in one hop
+    (reference: any node coordinates, executor.Execute executor.go:113)."""
     coord = cluster.clients[cluster.coord]
-    other = cluster.clients[(cluster.coord + 1) % 3]
     cols = [s * SHARD_WIDTH + 3 for s in range(4)]
     coord.import_bits("sp", "f", [9] * len(cols), cols)
     time.sleep(0.2)
     before = _spmd_steps(cluster)
-    # query via a non-coordinator node: HTTP merge, same answer
-    got = other.query("sp", "Count(Row(f=9))")["results"][0]
-    assert got == len(cols)
-    # an uncoverable tree (Shift) on the coordinator: HTTP merge
-    got = coord.query(
-        "sp", "Count(Shift(Row(f=9), n=1))")["results"][0]
-    assert got == len(cols)
+    # drive every node round-robin: each query is one collective step
+    for i in range(3):
+        node = cluster.clients[(cluster.coord + i) % 3]
+        got = node.query("sp", "Count(Row(f=9))")["results"][0]
+        assert got == len(cols)
+    after = _spmd_steps(cluster)
+    assert all(a - b == 3 for a, b in zip(after, before)), (before, after)
+    # the two non-coordinator nodes each recorded one forward
+    forwards = [cl._request("GET", "/internal/spmd/stats")["forwarded"]
+                for cl in cluster.clients]
+    assert sum(forwards) >= 2, forwards
+
+
+def test_uncoverable_falls_back(cluster):
+    coord = cluster.clients[cluster.coord]
+    cols = [s * SHARD_WIDTH + 3 for s in range(4)]
+    coord.import_bits("sp", "f", [9] * len(cols), cols)
+    time.sleep(0.2)
+    before = _spmd_steps(cluster)
+    # an uncoverable tree (Shift): HTTP merge on coordinator AND forwarded
+    for cl in (coord, cluster.clients[(cluster.coord + 1) % 3]):
+        got = cl.query(
+            "sp", "Count(Shift(Row(f=9), n=1))")["results"][0]
+        assert got == len(cols)
     after = _spmd_steps(cluster)
     assert after == before, (before, after)
+
+
+def test_count_preflight_amortized(cluster):
+    """Steady-state SPMD Count costs ONE control-plane round: the
+    validation round runs once per (index, membership) epoch, not per
+    query — the step carries its whole plan (VERDICT r3 item 6)."""
+    coord = cluster.clients[cluster.coord]
+    stats = lambda: coord._request("GET", "/internal/spmd/stats")  # noqa
+    coord.query("sp", "Count(Row(f=1))")  # prime the epoch
+    s0 = stats()
+    coord.query("sp", "Count(Row(f=1))")
+    coord.query("sp", "Count(Row(f=9))")
+    s1 = stats()
+    assert s1["steps"] - s0["steps"] == 2
+    assert s1["validations"] == s0["validations"], (s0, s1)
+    assert s1["validations_skipped"] - s0["validations_skipped"] == 2
 
 
 def test_row_results_still_http(cluster):
@@ -231,5 +266,78 @@ def test_topn_merges_via_collective(cluster):
     before = after
     got = coord.query("sp", "TopN(tf, Row(g=9), n=3)")["results"][0]
     assert got == [{"id": 1, "count": 6}]
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+
+def test_minmax_merges_via_collective(cluster):
+    """Min/Max ride the SPMD data plane: the narrowing bit-plane walk runs
+    once over globally-sharded planes, its any() reductions becoming
+    cross-process collectives."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "w", options={"type": "int",
+                                           "min": -500, "max": 500})
+    time.sleep(1.0)  # DDL broadcast settles
+    cols = [s * SHARD_WIDTH + off for s in range(6) for off in (4, 19)]
+    vals = [((i * 53) % 901) - 450 for i in range(len(cols))]
+    coord.import_values("sp", "w", cols, vals)
+
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "Min(field=w)")["results"][0]
+    assert got == {"value": min(vals), "count": vals.count(min(vals))}
+    got = coord.query("sp", "Max(field=w)")["results"][0]
+    assert got == {"value": max(vals), "count": vals.count(max(vals))}
+    after = _spmd_steps(cluster)
+    assert all(a - b == 2 for a, b in zip(after, before)), (before, after)
+
+    # filtered Min (coverable filter) also rides the collective
+    coord.import_bits("sp", "f", [88] * (len(cols) // 2), cols[::2])
+    before = after
+    got = coord.query("sp", "Min(Row(f=88), field=w)")["results"][0]
+    fv = vals[::2]
+    assert got == {"value": min(fv), "count": fv.count(min(fv))}
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+
+def test_groupby_merges_via_collective(cluster):
+    """GroupBy rides the SPMD data plane: per-child candidate rows union
+    in the validation round, then ONE program counts the full
+    cross-product with the all-reduce (reference merge: executor.go:1098)."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "ga")
+    coord.create_field("sp", "gb")
+    time.sleep(1.0)
+    # ga rows 1,2 / gb rows 10,11 over 6 shards with a known overlap
+    rows_a, cols_a, rows_b, cols_b = [], [], [], []
+    for s in range(6):
+        base = s * SHARD_WIDTH
+        rows_a += [1, 1, 2]
+        cols_a += [base + 0, base + 1, base + 2]
+        rows_b += [10, 11, 11]
+        cols_b += [base + 0, base + 1, base + 2]
+    coord.import_bits("sp", "ga", rows_a, cols_a)
+    coord.import_bits("sp", "gb", rows_b, cols_b)
+
+    expected = [
+        {"group": [{"field": "ga", "rowID": 1},
+                   {"field": "gb", "rowID": 10}], "count": 6},
+        {"group": [{"field": "ga", "rowID": 1},
+                   {"field": "gb", "rowID": 11}], "count": 6},
+        {"group": [{"field": "ga", "rowID": 2},
+                   {"field": "gb", "rowID": 11}], "count": 6},
+    ]
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "GroupBy(Rows(ga), Rows(gb))")["results"][0]
+    assert got == expected
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+    # non-coordinator initiation works for GroupBy too (one forward hop)
+    other = cluster.clients[(cluster.coord + 1) % 3]
+    before = after
+    got = other.query(
+        "sp", "GroupBy(Rows(ga), Rows(gb), limit=2)")["results"][0]
+    assert got == expected[:2]
     after = _spmd_steps(cluster)
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
