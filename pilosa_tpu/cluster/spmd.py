@@ -133,6 +133,7 @@ class SpmdDataPlane:
         self.forwarded = 0
         self.forward_errors = 0
         self.fallbacks = 0  # eligible calls declined past the gate (caps…)
+        self._local_exec = None  # set by API (shared serving executor)
         # The JAX process set is fixed at startup (initialize is
         # once-only); if the cluster later grows or shrinks, SPMD must
         # decline — new nodes are not mesh participants.
@@ -177,22 +178,47 @@ class SpmdDataPlane:
         checks consult only REPLICATED state (the schema): local
         view/fragment existence differs per node, and a node that owns no
         shards of a field simply contributes zero planes."""
+        from ..exec.bsicond import normalize_bsi_condition
         from ..exec.stacked import tree_signature
 
         def leaf(idx, field_name, row_id, leaves):
             if idx.field(field_name) is None:
                 return None
-            key = (field_name, int(row_id))
+            key = ("row", field_name, int(row_id))
+            if key not in leaves:
+                leaves[key] = len(leaves)
+            return ("leaf", leaves[key])
+
+        def bsi_leaf(idx, field_name, cond, leaves):
+            field = idx.field(field_name)
+            if field is None or field.options.type != "int":
+                return None
+            norm = normalize_bsi_condition(cond)
+            if norm is None:
+                return None
+            op, vals = norm
+            key = ("bsicond", field_name, op, vals)
             if key not in leaves:
                 leaves[key] = len(leaves)
             return ("leaf", leaves[key])
 
         leaves = {}
-        sig = tree_signature(idx, call, leaves, leaf)
+        sig = tree_signature(idx, call, leaves, leaf, bsi_leaf)
         if sig is None or not leaves:
             return None
         ordered = sorted(leaves.items(), key=lambda kv: kv[1])
         return sig, [key for key, _ in ordered]
+
+    @staticmethod
+    def _leaf_to_wire(key):
+        """Leaf key -> JSON-able tagged entry: ["row", field, row_id] or
+        ["bsicond", field, op, values]."""
+        if key[0] == "bsicond":
+            _, field_name, op, vals = key
+            return ["bsicond", field_name, op,
+                    list(vals) if isinstance(vals, tuple) else vals]
+        _, field_name, row_id = key
+        return ["row", field_name, row_id]
 
     def _plan_filter(self, idx, step, filter_call):
         """Attach an optional filter plan to a step; False when the filter
@@ -206,7 +232,7 @@ class SpmdDataPlane:
             return False
         sig, leaf_keys = sig_leaves
         step["sig"] = sig_to_wire(sig)
-        step["leaves"] = [[f, r] for f, r in leaf_keys]
+        step["leaves"] = [self._leaf_to_wire(k) for k in leaf_keys]
         return True
 
     # -- entry (any node) ----------------------------------------------------
@@ -245,11 +271,15 @@ class SpmdDataPlane:
         coord = cluster.coordinator
         if coord is None:
             return False, None
-        if not self._eligible(idx, call, kind):
-            return False, None  # schema-level decline: no hop, no gate work
         if coord.id != cluster.local_id:
             if forwarded:
                 return False, None  # never bounce a forwarded call again
+            # schema-level pre-check so a call the coordinator would
+            # refuse anyway never pays the forward hop (the coordinator
+            # itself skips this: its _try_* handlers re-derive the same
+            # signatures as part of building the step plan)
+            if not self._eligible(idx, call, kind):
+                return False, None
             return self._forward(idx, call, shards, coord)
         try_fn = {
             "count": self._try_count,
@@ -296,6 +326,7 @@ class SpmdDataPlane:
                 return False
             if call.args.get("tanimotoThreshold") \
                     or call.args.get("attrName") is not None \
+                    or call.args.get("ids") is not None \
                     or len(call.children) > 1:
                 return False
             filter_call = call.children[0] if call.children else None
@@ -441,7 +472,7 @@ class SpmdDataPlane:
         sig, leaf_keys = sig_leaves
         step["kind"] = "count"
         step["sig"] = sig_to_wire(sig)
-        step["leaves"] = [[f, r] for f, r in leaf_keys]
+        step["leaves"] = [self._leaf_to_wire(k) for k in leaf_keys]
         # Pre-flight, amortized: the step carries its whole plan, so the
         # per-peer checks (spmd enabled, index present, device count,
         # membership) are constant within a membership epoch — validate
@@ -554,9 +585,12 @@ class SpmdDataPlane:
         if field is None or field.options.type == "int":
             return None
         # tanimoto needs per-row plain counts + src count; attr filters
-        # need the attr store — both stay on the HTTP/local path
+        # need the attr store; ids restricts the candidate set to exactly
+        # the requested rows (restrict_ids semantics, executor.go:947) —
+        # all stay on the HTTP/local path
         if call.args.get("tanimotoThreshold") \
-                or call.args.get("attrName") is not None:
+                or call.args.get("attrName") is not None \
+                or call.args.get("ids") is not None:
             return None
         if len(call.children) > 1:
             return None
@@ -646,6 +680,18 @@ class SpmdDataPlane:
                 per_child = r.get("rows", [])
                 if i < len(per_child):
                     rows.update(int(x) for x in per_child[i])
+            # Over-cap decline happens BEFORE previous/limit pruning: the
+            # per-node candidate lists are truncated at the cap, so a
+            # merged set past it may be missing rows — pruning first could
+            # shrink an incomplete set under the cap and return a silently
+            # wrong (partial) result instead of falling back to HTTP.
+            if len(rows) > self.GROUPBY_MAX_CELLS:
+                self.fallbacks += 1
+                self.logger.printf(
+                    "spmd: GroupBy child %s has %d candidate rows "
+                    "(cap %d); falling back to HTTP merge", field.name,
+                    len(rows), self.GROUPBY_MAX_CELLS)
+                return None
             rows = sorted(rows)
             # child Rows() args apply to the GLOBAL merged set (exactly
             # executor._exec_rows semantics)
@@ -844,8 +890,45 @@ class SpmdDataPlane:
                 "contributing zero planes", field_name, row_id, e)
         return local
 
+    def _local_cond_block(self, idx, step, field_name, op, vals):
+        """This process's [seg_len, W] block of one BSI condition leaf
+        (e.g. v > 10): evaluated per owned shard against LOCAL planes with
+        the shared condition plan — per-node clamping against local bit
+        depth is exact for local data, since a node's values were written
+        within its own depth. Defensive like _local_block."""
+        from ..exec.bsicond import condition_from_key
+
+        seg_len = int(step["seg_len"])
+        my_shards = step["segments"].get(self.cluster.local_id, [])
+        local = np.zeros((seg_len, WORDS_PER_ROW), dtype=np.uint32)
+        try:
+            call = Call("Row", args={
+                field_name: condition_from_key(op, vals)})
+            ex = self._local_executor()
+            for j, shard in enumerate(my_shards[:seg_len]):
+                plane = ex.bitmap_call_shard(idx, call, shard)
+                if plane is not None:
+                    local[j] = np.asarray(plane)
+        except Exception as e:
+            self.logger.printf(
+                "spmd: local condition gather failed (%s %s %s): %s — "
+                "contributing zero planes", field_name, op, vals, e)
+        return local
+
+    def _local_executor(self):
+        """Executor for per-shard condition-leaf evaluation. The API
+        shares its serving executor here (server/api.py) so no second
+        evaluator is built; standalone/test construction falls back to a
+        lazy private instance."""
+        if self._local_exec is None:
+            from ..exec.executor import Executor
+
+            self._local_exec = Executor(self.holder)
+        return self._local_exec
+
     def _leaf_arrays(self, idx, step):
-        """Globally-sharded [S, W] arrays for a step's plan leaves."""
+        """Globally-sharded [S, W] arrays for a step's plan leaves
+        (tagged wire entries: ["row", f, r] | ["bsicond", f, op, vals])."""
         import jax
 
         n_proc = self._num_processes()
@@ -853,8 +936,15 @@ class SpmdDataPlane:
         sharding = self._global_sharding()
         global_shape = (n_proc * seg_len, WORDS_PER_ROW)
         arrays = []
-        for field_name, row_id in step.get("leaves", []):
-            local = self._local_block(idx, step, field_name, int(row_id))
+        for entry in step.get("leaves", []):
+            if entry[0] == "bsicond":
+                _, field_name, op, vals = entry
+                local = self._local_cond_block(
+                    idx, step, field_name, op, vals)
+            else:
+                _, field_name, row_id = entry
+                local = self._local_block(idx, step, field_name,
+                                          int(row_id))
             arrays.append(jax.make_array_from_process_local_data(
                 sharding, local, global_shape=global_shape))
         return arrays, global_shape

@@ -25,7 +25,7 @@ from ..core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
 from ..core.row import Row
 from ..core import timeq
 from ..core.view import VIEW_STANDARD
-from ..pql import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, parse
+from ..pql import Call, Condition, parse
 from ..shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 from .result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount
 
@@ -368,116 +368,33 @@ class Executor:
         return frag.row_device(BSI_EXISTS_BIT)
 
     def _row_bsi_shard(self, idx, call, shard):
-        from ..ops import bitplane, bsi as bsi_ops
-        import jax.numpy as jnp
+        """Row(field <op> value) for one shard via the shared condition
+        plan (exec/bsicond.py — the same plan+kernels evaluate stacked
+        [D,S,W] planes on the serving path). Reference:
+        executeRowBSIGroupShard executor.go:1533."""
+        from .bsicond import BsiConditionError, apply_bsi_condition, \
+            bsi_condition_plan
 
         if len(call.args) != 1:
             raise ExecError("Row(): condition required" if not call.args
                             else "Row(): too many arguments")
         field_name, cond = next(iter(call.args.items()))
         if not isinstance(cond, Condition):
-            raise ExecError(f"Row(): expected condition argument")
+            raise ExecError("Row(): expected condition argument")
         field = self._bsi_meta(idx, field_name)
-        opts = field.options
-        depth = opts.bit_depth
-        depth_min = opts.base - (1 << depth) + 1
-        depth_max = opts.base + (1 << depth) - 1
-
-        if cond.op == NEQ and cond.value is None:
-            # != null
+        try:
+            plan = bsi_condition_plan(field.options, cond)
+        except BsiConditionError as e:
+            raise ExecError(str(e)) from e
+        if plan[0] == "empty":
+            return None
+        if plan[0] == "notnull":
             return self._not_null_plane(field, shard)
-
-        if cond.op == BETWEEN:
-            predicates = cond.int_values()
-            if len(predicates) != 2:
-                raise ExecError(
-                    "Row(): BETWEEN condition requires exactly two integer values")
-            lo, hi = predicates
-            if hi < depth_min or lo > depth_max:
-                return None
-            lo_c = max(lo, depth_min) - opts.base
-            hi_c = min(hi, depth_max) - opts.base
-            data = self._bsi_planes(field, shard)
-            if data is None:
-                return None
-            planes, sign, exists = data
-            if lo <= opts.min and hi >= opts.max:
-                return exists
-            return self._between(planes, sign, exists, lo_c, hi_c, depth)
-
-        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
-            raise ExecError("Row(): conditions only support integer values")
-        value = cond.value
-
-        # out-of-depth-range clamping (reference: bsiGroup.baseValue)
-        if cond.op in (GT, GTE):
-            if value > depth_max:
-                return None
-            base_value = value - opts.base if value > depth_min else \
-                depth_min - opts.base
-        elif cond.op in (LT, LTE):
-            if value < depth_min:
-                return None
-            base_value = (min(value, depth_max)) - opts.base
-        else:  # EQ / NEQ
-            out_of_range = value < depth_min or value > depth_max
-            if out_of_range and cond.op == EQ:
-                return None
-            if out_of_range:  # NEQ out of range -> all not-null
-                return self._not_null_plane(field, shard)
-            base_value = value - opts.base
-
         data = self._bsi_planes(field, shard)
         if data is None:
             return None
         planes, sign, exists = data
-
-        # full-range fast path -> notNull (reference: executor.go:1650)
-        if ((cond.op == LT and value > opts.max)
-                or (cond.op == LTE and value >= opts.max)
-                or (cond.op == GT and value < opts.min)
-                or (cond.op == GTE and value <= opts.min)):
-            return exists
-
-        pbits = jnp.asarray(bsi_ops.predicate_bits(abs(base_value), depth))
-        neg = base_value < 0
-        if cond.op == EQ:
-            return bsi_ops.range_eq(planes, sign, exists, pbits, neg)
-        if cond.op == NEQ:
-            eq = bsi_ops.range_eq(planes, sign, exists, pbits, neg)
-            return bitplane.difference(exists, eq)
-        if cond.op in (LT, LTE):
-            return bsi_ops.range_lt(planes, sign, exists, pbits, neg,
-                                    cond.op == LTE)
-        return bsi_ops.range_gt(planes, sign, exists, pbits, neg,
-                                cond.op == GTE)
-
-    def _between(self, planes, sign, exists, lo, hi, depth):
-        """Signed BETWEEN via unsigned magnitude compares on the sign slices
-        (reference: fragment.rangeBetween fragment.go:1437)."""
-        from ..ops import bitplane, bsi as bsi_ops
-        import jax.numpy as jnp
-
-        pos = bitplane.difference(exists, sign)
-        neg = bitplane.intersect(exists, sign)
-
-        def ubits(v):
-            return jnp.asarray(bsi_ops.predicate_bits(abs(v), depth))
-
-        if lo >= 0:
-            # all within positives
-            return bsi_ops.range_between_unsigned(
-                planes, pos, ubits(lo), ubits(hi))
-        if hi < 0:
-            # all within negatives: magnitudes between |hi| and |lo|
-            return bsi_ops.range_between_unsigned(
-                planes, neg, ubits(hi), ubits(lo))
-        # straddles zero: negatives with mag <= |lo|, positives with mag <= hi
-        lower = bsi_ops.range_between_unsigned(
-            planes, neg, ubits(0), ubits(lo))
-        upper = bsi_ops.range_between_unsigned(
-            planes, pos, ubits(0), ubits(hi))
-        return bitplane.union(lower, upper)
+        return apply_bsi_condition(plan, planes, sign, exists)
 
     # ------------------------------------------------------------ aggregates
 

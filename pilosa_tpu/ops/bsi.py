@@ -68,14 +68,15 @@ def predicate_bits(upredicate, depth):
 def compare_unsigned(planes, pbits):
     """One-pass vectorized comparator of per-column magnitudes vs. predicate.
 
-    Returns (lt, eq, gt) masks, each [W]. Equivalent to the reference's
-    rangeLTUnsigned / rangeGTUnsigned / rangeEQ scans (fragment.go:1357-1470)
-    but computed simultaneously with no branching.
+    Returns (lt, eq, gt) masks, each shaped like one plane. Equivalent to
+    the reference's rangeLTUnsigned / rangeGTUnsigned / rangeEQ scans
+    (fragment.go:1357-1470) but computed simultaneously with no branching.
+    Shape-polymorphic: `planes` may be [D, W] (one shard) or [D, S, W]
+    (stacked serving path) — the scan is elementwise over plane shape.
     """
-    w = planes.shape[1]
-    eq = jnp.full((w,), FULL, dtype=jnp.uint32)
-    lt = jnp.zeros((w,), dtype=jnp.uint32)
-    gt = jnp.zeros((w,), dtype=jnp.uint32)
+    eq = jnp.full(planes.shape[1:], FULL, dtype=jnp.uint32)
+    lt = jnp.zeros(planes.shape[1:], dtype=jnp.uint32)
+    gt = jnp.zeros(planes.shape[1:], dtype=jnp.uint32)
 
     def step(carry, xs):
         lt, eq, gt = carry

@@ -127,6 +127,10 @@ class API:
 
             self.executor = ClusterExecutor(holder, cluster, client_factory,
                                             spmd=spmd, logger=self.logger)
+            if spmd is not None:
+                # share the serving executor for SPMD condition-leaf
+                # evaluation instead of building a second evaluator
+                spmd._local_exec = self.executor.local
             self.resize = ResizeManager(holder, cluster, self.client_factory)
         else:
             self.executor = Executor(holder)

@@ -300,6 +300,27 @@ def test_minmax_merges_via_collective(cluster):
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
 
 
+def test_bsi_condition_count_via_collective(cluster):
+    """Count(Row(v > t)) is SPMD-eligible: condition leaves ride the same
+    shared signature walk; each process contributes locally-evaluated
+    condition planes to the globally-sharded leaf array."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "cv", options={"type": "int",
+                                            "min": -100, "max": 100})
+    time.sleep(1.0)
+    cols = [s * SHARD_WIDTH + off for s in range(6) for off in (8, 21)]
+    vals = [((i * 17) % 201) - 100 for i in range(len(cols))]
+    coord.import_values("sp", "cv", cols, vals)
+
+    before = _spmd_steps(cluster)
+    got = coord.query("sp", "Count(Row(cv > 0))")["results"][0]
+    assert got == sum(1 for v in vals if v > 0)
+    got = coord.query("sp", "Count(Row(cv >< [-10, 10]))")["results"][0]
+    assert got == sum(1 for v in vals if -10 <= v <= 10)
+    after = _spmd_steps(cluster)
+    assert all(a - b == 2 for a, b in zip(after, before)), (before, after)
+
+
 def test_groupby_merges_via_collective(cluster):
     """GroupBy rides the SPMD data plane: per-child candidate rows union
     in the validation round, then ONE program counts the full

@@ -238,6 +238,162 @@ def test_stacked_rows_cache_hit(tmp_path):
     holder.close()
 
 
+def _build_bsi_index(tmp_path, name, n_shards, seed=7):
+    holder = Holder(str(tmp_path / name)).open()
+    api = API(holder)
+    api.create_index("i")
+    api.create_field("i", "v", FieldOptions.int_field(min=-200, max=200))
+    api.create_field("i", "f")
+    rng = np.random.default_rng(seed)
+    cols = np.sort(rng.choice(n_shards * SHARD_WIDTH, size=40 * n_shards,
+                              replace=False))
+    vals = rng.integers(-200, 201, size=cols.size)
+    api.import_values("i", "v", cols.tolist(), vals.tolist())
+    api.import_bits("i", "f", (cols % 3).tolist(), cols.tolist())
+    return holder, api, cols, vals
+
+
+@pytest.mark.parametrize("pql,pred", [
+    ("Count(Row(v > 10))", lambda v: v > 10),
+    ("Count(Row(v <= -5))", lambda v: v <= -5),
+    ("Count(Row(v == 0))", lambda v: v == 0),
+    ("Count(Row(v != 17))", lambda v: v != 17),
+    ("Count(Row(v >< [-50, 50]))", lambda v: (v >= -50) & (v <= 50)),
+])
+def test_bsi_condition_count_stacked(tmp_path, pql, pred):
+    """Condition trees are stacked-coverable: Count(Row(v > 10)) runs in
+    O(1)-in-shards dispatches (VERDICT r4 item 4; reference algorithm
+    fragment.go:1357-1470) and matches numpy."""
+    holder, api, cols, vals = _build_bsi_index(
+        tmp_path, f"cond{abs(hash(pql)) % 1000}", 4)
+    e = Executor(holder)
+    assert e.execute("i", pql)[0] == int(pred(vals).sum())
+    holder.close()
+
+
+def test_bsi_condition_dispatch_invariance(tmp_path):
+    """Dispatch-invariance in the test_stacked_serving.py:201 style for a
+    condition query, plus agreement with the per-shard path."""
+    counts = {}
+    for n_shards in (3, 6):
+        holder, api, cols, vals = _build_bsi_index(
+            tmp_path, f"cd{n_shards}", n_shards)
+        e = Executor(holder)
+        e.execute("i", "Count(Row(v > 10))")  # warm stacks + compiles
+        before = e._stacked.dispatches
+        got = e.execute("i", "Count(Row(v > 10))")[0]
+        counts[n_shards] = e._stacked.dispatches - before
+        assert got == int((vals > 10).sum())
+        # per-shard fallback path agrees (single shard < MIN_SHARDS)
+        per_shard = sum(
+            e.execute("i", "Count(Row(v > 10))", shards=[s])[0]
+            for s in range(n_shards))
+        assert per_shard == got
+        holder.close()
+    assert counts[3] == counts[6] > 0, counts
+
+
+def test_bsi_condition_filtered_aggregates_stacked(tmp_path):
+    """Condition leaves compose as filters: condition-filtered Sum/TopN/
+    intersections ride the stacked path and stay exact."""
+    holder, api, cols, vals = _build_bsi_index(tmp_path, "condagg", 4)
+    e = Executor(holder)
+
+    got = e.execute("i", "Sum(Row(v > 0), field=v)")[0]
+    sel = vals > 0
+    assert got.val == int(vals[sel].sum())
+    assert got.count == int(sel.sum())
+
+    got = e.execute("i", "Count(Intersect(Row(f=1), Row(v >= 100)))")[0]
+    assert got == int(((cols % 3 == 1) & (vals >= 100)).sum())
+
+    got = e.execute("i", "TopN(f, Row(v < 0), n=3)")[0]
+    want = {r: int(((cols % 3 == r) & (vals < 0)).sum()) for r in range(3)}
+    assert {p.id: p.count for p in got} == \
+        {r: c for r, c in want.items() if c > 0}
+
+    # a write patches the BSI stack and the next condition count is exact
+    holder.index("i").field("v").set_value(2 * SHARD_WIDTH + 123, 150)
+    got = e.execute("i", "Count(Row(v > 10))")[0]
+    assert got == int((vals > 10).sum()) + 1
+    holder.close()
+
+
+def test_count_patch_on_single_shard_write(tmp_path):
+    """A write to ONE of many shards must NOT re-upload the whole serving
+    stack: the next Count patches only the drifted shard's plane on device
+    (device analog of op-log deltas over a snapshot, roaring.go:228-249)
+    and stays exact."""
+    n_shards = 16
+    holder, api = _build_index(tmp_path, "patch", n_shards)
+    e = Executor(holder)
+    base = e.execute("i", "Count(Row(f=1))")[0]
+    st = e._stacked
+
+    # one set_bit into one shard -> next Count uploads O(1) planes
+    api.query("i", f"Set({3 * SHARD_WIDTH + 500}, f=1)")
+    up0, p0 = st.planes_uploaded, st.patches
+    got = e.execute("i", "Count(Row(f=1))")[0]
+    assert got == base + 1
+    assert st.patches == p0 + 1
+    assert st.planes_uploaded - up0 == 1, (st.planes_uploaded - up0)
+
+    # clear it again: another 1-plane patch, exact result
+    api.query("i", f"Clear({3 * SHARD_WIDTH + 500}, f=1)")
+    up0 = st.planes_uploaded
+    assert e.execute("i", "Count(Row(f=1))")[0] == base
+    assert st.planes_uploaded - up0 == 1
+    holder.close()
+
+
+def test_sum_patch_on_single_shard_write(tmp_path):
+    """BSI stacks patch incrementally too: a single set_value re-uploads
+    one shard's D+2 planes, not depth x shards."""
+    holder = Holder(str(tmp_path / "bsipatch")).open()
+    api = API(holder)
+    api.create_index("i")
+    api.create_field("i", "v", FieldOptions.int_field(min=0, max=1000))
+    n_shards = 8
+    cols = [s * SHARD_WIDTH + 3 for s in range(n_shards)]
+    vals = [10 * (s + 1) for s in range(n_shards)]
+    api.import_values("i", "v", cols, vals)
+    e = Executor(holder)
+    assert e.execute("i", "Sum(field=v)")[0].val == sum(vals)
+    st = e._stacked
+
+    holder.index("i").field("v").set_value(5 * SHARD_WIDTH + 9, 7)
+    up0, p0 = st.planes_uploaded, st.patches
+    got = e.execute("i", "Sum(field=v)")[0]
+    assert got.val == sum(vals) + 7
+    assert got.count == n_shards + 1
+    assert st.patches == p0 + 1
+    depth = holder.index("i").field("v").options.bit_depth
+    # one shard's exists+sign+magnitude planes only
+    assert st.planes_uploaded - up0 == depth + 2
+    holder.close()
+
+
+def test_topn_rows_stack_patch_on_write(tmp_path):
+    """TopN candidate chunks patch per-shard as well: a one-bit write
+    costs rows x 1 plane uploads, not rows x shards."""
+    n_shards = 12
+    holder, api = _build_index(tmp_path, "rowspatch", n_shards)
+    e = Executor(holder)
+    r1 = e.execute("i", "TopN(f, n=6)")[0]
+    st = e._stacked
+
+    api.query("i", f"Set({7 * SHARD_WIDTH + 900}, f=2)")
+    up0, p0 = st.planes_uploaded, st.patches
+    r2 = e.execute("i", "TopN(f, n=6)")[0]
+    assert st.patches == p0 + 1
+    # 6 candidate rows, 1 drifted shard
+    assert st.planes_uploaded - up0 == 6
+    want = {p.id: p.count for p in r1}
+    want[2] += 1
+    assert {p.id: p.count for p in r2} == want
+    holder.close()
+
+
 # ------------------------------------------------------------ int32 overflow
 
 
