@@ -47,6 +47,17 @@ class Cluster:
         self._lock = threading.RLock()
         if self.nodes and not any(n.is_coordinator for n in self.nodes):
             self.nodes[0].is_coordinator = True
+        # Gossiped shard map (reference: availableShards carried in gossip
+        # NodeStatus / CreateShardMessage, cluster.go): peers PUSH their
+        # per-index available shards over the control plane so queries
+        # never do per-peer shard-discovery HTTP in the steady state.
+        # Entries MERGE by union (pushes are unordered best-effort async;
+        # a reordered older full list must not shrink the set — shrink
+        # events, resize/delete, invalidate the whole map instead), and
+        # seeds carry a timestamp: a seed older than SHARD_MAP_TTL is
+        # re-fetched once, bounding the staleness window of a LOST push.
+        self._remote_shards = {}   # node_id -> {index: set(shards)}
+        self._shards_synced = {}   # (node_id, index) -> monotonic seed time
 
     # -- identity ------------------------------------------------------------
 
@@ -143,12 +154,72 @@ class Cluster:
             node = self.node(node_id)
             if node is not None and node.state != state:
                 node.state = state
+                # a node that flapped may have grown shards while its
+                # pushes were lost; force one re-seed fetch on next query
+                self._shards_synced = {
+                    key: ts for key, ts in self._shards_synced.items()
+                    if key[0] != node_id}
                 self.determine_state()
                 return True
         return False
 
     def live_nodes(self):
         return [n for n in self.nodes if n.state == NODE_STATE_READY]
+
+    # -- gossiped shard map ---------------------------------------------------
+
+    #: seconds before a peer's seed is re-fetched once — bounds how long a
+    #: LOST async push can leave the map stale (the reference's gossip
+    #: re-converges continuously; this is the pull-side analog)
+    SHARD_MAP_TTL = 30.0
+
+    def set_remote_shards(self, node_id, index, shards):
+        """Merge a peer's pushed per-index shard list. UNION, not replace:
+        async pushes can arrive out of order and an older (smaller) full
+        list must not erase shards a newer push already delivered. Shard
+        sets only shrink on resize/delete, which invalidate the whole map
+        (invalidate_shard_map / drop_remote_index)."""
+        import time as _time
+
+        with self._lock:
+            self._remote_shards.setdefault(node_id, {}).setdefault(
+                index, set()).update(int(s) for s in shards)
+            self._shards_synced[(node_id, index)] = _time.monotonic()
+
+    def shards_synced(self, node_id, index):
+        import time as _time
+
+        with self._lock:
+            ts = self._shards_synced.get((node_id, index))
+            return ts is not None \
+                and _time.monotonic() - ts < self.SHARD_MAP_TTL
+
+    def remote_available_shards(self, index):
+        """Union of every peer's last-pushed shards for an index."""
+        out = set()
+        with self._lock:
+            for per_index in self._remote_shards.values():
+                out |= per_index.get(index, set())
+        return out
+
+    def drop_remote_index(self, index):
+        with self._lock:
+            for per_index in self._remote_shards.values():
+                per_index.pop(index, None)
+            self._shards_synced = {
+                key: ts for key, ts in self._shards_synced.items()
+                if key[1] != index}
+
+    def invalidate_shard_map(self):
+        """Drop everything learned about peers' shards. Called on ANY
+        membership/placement change (node join/leave, resize completion):
+        a resize re-sorts the node list, so EXISTING nodes can gain shards
+        (streamed outside the push hooks) and stale entries would serve
+        silently incomplete shard lists. The next query re-seeds each peer
+        once."""
+        with self._lock:
+            self._remote_shards.clear()
+            self._shards_synced.clear()
 
     # -- membership changes ---------------------------------------------------
 
@@ -161,6 +232,7 @@ class Cluster:
             if not any(n.is_coordinator for n in self.nodes):
                 self.nodes[0].is_coordinator = True
             self.save_topology()
+            self.invalidate_shard_map()
             return True
 
     def remove_node(self, node_id):
@@ -172,6 +244,7 @@ class Cluster:
             if node.is_coordinator and self.nodes:
                 self.nodes[0].is_coordinator = True
             self.save_topology()
+            self.invalidate_shard_map()
             return True
 
     # -- topology persistence (reference: cluster.go:1580-1692) ---------------

@@ -382,14 +382,19 @@ class ClusterExecutor:
     # -- shard discovery -----------------------------------------------------
 
     def cluster_shards(self, idx):
-        """Union of available shards across all live nodes, fetched in
-        parallel (the reference gossips availableShards per index; here
-        it's one GET /internal/index/{i}/shards per peer, once per
-        query)."""
+        """Union of available shards across all live nodes. Steady state:
+        ZERO shard-discovery HTTP — peers PUSH their per-index shard sets
+        over the control plane on every change (CREATE_SHARD messages;
+        the reference gossips availableShards the same way) and this just
+        reads the local map. A peer is fetched over HTTP only to SEED the
+        map: once per (peer, index), and again after a node-state flap
+        (its pushes may have been lost while unreachable)."""
         shards = set(idx.available_shards())
-        lock = threading.Lock()
 
         from .node import NODE_STATE_DOWN
+
+        stale = [n for n in self.cluster.peers()
+                 if not self.cluster.shards_synced(n.id, idx.name)]
 
         def fetch(node):
             try:
@@ -402,17 +407,21 @@ class ClusterExecutor:
                     # surface from their fetches regardless.
                     client.timeout = 2
                 resp = client.index_shards(idx.name)
-                with lock:
-                    shards.update(resp.get("shards", []))
+                self.cluster.set_remote_shards(
+                    node.id, idx.name, resp.get("shards", []))
             except Exception:
-                pass  # unreachable: replicated shards come from peers
+                # not marked synced -> retried next query; replicated
+                # shards come from its replicas meanwhile
+                pass
 
-        threads = [threading.Thread(target=fetch, args=(n,))
-                   for n in self.cluster.peers()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        if stale:
+            threads = [threading.Thread(target=fetch, args=(n,))
+                       for n in stale]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        shards |= self.cluster.remote_available_shards(idx.name)
         return sorted(shards)
 
     def _client(self, node):

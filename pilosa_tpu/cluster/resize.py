@@ -172,6 +172,7 @@ class ResizeManager:
         self.cluster.nodes = sorted(job.old_nodes, key=lambda n: n.id)
         self.cluster.state = CLUSTER_STATE_NORMAL
         self.cluster.save_topology()
+        self.cluster.invalidate_shard_map()
         self._broadcast_status(CLUSTER_STATE_NORMAL, job.old_nodes,
                                targets=job.old_nodes + job.new_nodes)
 
@@ -250,6 +251,7 @@ class ResizeManager:
         self.cluster.nodes = sorted(job.new_nodes, key=lambda n: n.id)
         self.cluster.state = CLUSTER_STATE_NORMAL
         self.cluster.save_topology()
+        self.cluster.invalidate_shard_map()
         self._broadcast_status(CLUSTER_STATE_NORMAL, job.new_nodes,
                                targets=job.old_nodes + job.new_nodes)
         clean_holder(self.holder, self.cluster)
@@ -364,6 +366,9 @@ class ResizeManager:
                     self.cluster.save_topology()
                 if state:
                     self.cluster.state = state
+            # placement changed (or is about to): everything learned about
+            # peers' shards is suspect — force a re-seed on next query
+            self.cluster.invalidate_shard_map()
             if state == CLUSTER_STATE_NORMAL and nodes:
                 clean_holder(self.holder, self.cluster)
             return True

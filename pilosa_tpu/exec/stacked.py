@@ -469,16 +469,22 @@ class StackedEvaluator:
                 field.options, condition_from_key(op, vals))
         except BsiConditionError:
             return None
+        # the empty/notnull plans need no magnitude planes (bsicond.py
+        # contract) — don't gather+upload the whole [D+2, S, W] stack
+        if plan[0] == "empty":
+            import jax.numpy as jnp
+
+            return jnp.zeros((self._padded_len(tuple(shards)),
+                              WORDS_PER_ROW), dtype=jnp.uint32)
+        if plan[0] == "notnull":
+            stack = self.rows_stack(idx, field_name, (BSI_EXISTS_BIT,),
+                                    tuple(shards),
+                                    view_name=field.bsi_view_name())
+            return None if stack is None else stack[0]
         data = self.bsi_stack(idx, field_name, shards)
         if data is None:
             return None
         planes, sign, exists = data
-        if plan[0] == "empty":
-            import jax.numpy as jnp
-
-            return jnp.zeros_like(exists)
-        if plan[0] == "notnull":
-            return exists
         self.dispatches += 1
         return apply_bsi_condition(plan, planes, sign, exists)
 

@@ -119,6 +119,8 @@ class API:
         # api.go:1157); None disables the log.
         self.long_query_time = long_query_time
         self.logger = logger if logger is not None else StandardLogger()
+        # last per-index shard set pushed to peers (gossiped shard map)
+        self._pushed_shards = {}
         if client_factory is None:
             from .client import Client as client_factory  # noqa: N813
         self.client_factory = client_factory
@@ -169,7 +171,32 @@ class API:
         except Exception as e:
             raise ApiError(str(e)) from e
         self._log_slow_query(index_name, pql, time.monotonic() - t0)
+        if any(c.writes() for c in query.calls):
+            self._broadcast_shards_if_changed(index_name)
         return results
+
+    def _broadcast_shards_if_changed(self, index_name):
+        """Push this node's per-index available shards to peers when they
+        changed (reference: availableShards gossiped via
+        CreateShardMessage / NodeStatus, cluster.go) so shard discovery
+        reads the pushed map instead of per-query peer GETs."""
+        if self.cluster is None or len(self.cluster.nodes) <= 1:
+            return
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return
+        shards = set(idx.available_shards())
+        if self._pushed_shards.get(index_name) == shards:
+            return
+        self._pushed_shards[index_name] = shards
+        try:
+            self._broadcast(MessageType.CREATE_SHARD, {
+                "index": index_name,
+                "node": self.cluster.local_id,
+                "shards": sorted(shards)}, sync=False)
+        except Exception:
+            # best-effort: the lazy per-peer seed fetch still converges
+            pass
 
     def column_attr_sets(self, index_name, results):
         """Column attr sets for every Row result's columns (reference:
@@ -227,6 +254,9 @@ class API:
             self.holder.delete_index(name)
         except HolderError as e:
             raise NotFoundError(str(e)) from e
+        self._pushed_shards.pop(name, None)
+        if self.cluster is not None:
+            self.cluster.drop_remote_index(name)
         if not remote:
             self._broadcast(MessageType.DELETE_INDEX, {"index": name})
 
@@ -335,6 +365,14 @@ class API:
             self.delete_field(payload["index"], payload["field"], remote=True)
         elif msg_type == MessageType.RECALCULATE_CACHES:
             self.holder.recalculate_caches()
+        elif msg_type == MessageType.CREATE_SHARD:
+            # a peer pushed its per-index available shards (gossiped
+            # shard map; reference: CreateShardMessage handling)
+            if self.cluster is not None \
+                    and payload.get("node") != self.cluster.local_id:
+                self.cluster.set_remote_shards(
+                    payload["node"], payload["index"],
+                    payload.get("shards", []))
         elif self.resize is not None and self.resize.receive(
                 msg_type, payload):
             pass  # resize/cluster-status/coordinator handled
@@ -343,7 +381,7 @@ class API:
                 self.cluster.set_node_state(
                     payload["id"], payload["state"])
         elif msg_type in (MessageType.NODE_EVENT, MessageType.NODE_STATUS,
-                          MessageType.CREATE_SHARD, MessageType.CLUSTER_STATUS,
+                          MessageType.CLUSTER_STATUS,
                           MessageType.CREATE_VIEW, MessageType.DELETE_VIEW,
                           MessageType.SET_COORDINATOR,
                           MessageType.UPDATE_COORDINATOR,
@@ -458,6 +496,7 @@ class API:
             changed = field.import_bits(
                 row_ids, column_ids, timestamps=timestamps, clear=clear)
             self.holder.index(index_name).add_existence(column_ids)
+            self._broadcast_shards_if_changed(index_name)
             return changed
 
         import numpy as np
@@ -499,6 +538,7 @@ class API:
                         timestamps=w, clear=clear, remote=True))))
         _, remote_changed = self._fan_out_writes(
             jobs, covered, count_shards=remote_only)
+        self._broadcast_shards_if_changed(index_name)
         return changed + remote_changed
 
     def import_values(self, index_name, field_name, column_ids, values,
@@ -508,6 +548,7 @@ class API:
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
             changed = field.import_values(column_ids, values)
             self.holder.index(index_name).add_existence(column_ids)
+            self._broadcast_shards_if_changed(index_name)
             return changed
 
         import numpy as np
@@ -536,6 +577,7 @@ class API:
                         remote=True))))
         _, remote_changed = self._fan_out_writes(
             jobs, covered, count_shards=remote_only)
+        self._broadcast_shards_if_changed(index_name)
         return changed + remote_changed
 
     def import_roaring(self, index_name, field_name, shard, data,
@@ -559,6 +601,7 @@ class API:
         _, remote_changed = self._fan_out_writes(
             jobs, {shard} if local else set(),
             count_shards=() if local else {shard})
+        self._broadcast_shards_if_changed(index_name)
         return changed if local else remote_changed
 
     def _field(self, index_name, field_name):

@@ -416,3 +416,53 @@ def test_shards_by_node_skips_down_primary():
     assert sorted(
         s for ss in c.shards_by_node("i", shards).values() for s in ss
     ) == shards
+
+
+def test_shard_discovery_gossips_not_polls():
+    """Steady-state shard discovery does ZERO per-query HTTP: nodes push
+    availableShards over the control plane (CREATE_SHARD messages;
+    reference gossips these) and queries read the local map. Peer GETs
+    happen only to seed the map once per (peer, index)."""
+    import time
+
+    from pilosa_tpu.server.client import Client
+
+    h = ClusterHarness(3, replica_n=1)
+    try:
+        h[0].client.create_index("gi")
+        h[0].client.create_field("gi", "gf")
+        time.sleep(0.2)
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        h[0].client.import_bits("gi", "gf", [1] * 6, cols)
+        time.sleep(0.5)  # async CREATE_SHARD pushes settle
+        # seeding phase: each node's first query may fetch unseen peers
+        for node in h.nodes:
+            assert node.client.query("gi", "Count(Row(gf=1))")["results"] \
+                == [6]
+
+        calls = {"n": 0}
+        orig = Client.index_shards
+
+        def counted(self, index):
+            calls["n"] += 1
+            return orig(self, index)
+
+        Client.index_shards = counted
+        try:
+            for node in h.nodes:
+                assert node.client.query(
+                    "gi", "Count(Row(gf=1))")["results"] == [6]
+            assert calls["n"] == 0, calls
+            # a write that creates a NEW shard converges via the push, not
+            # via polling: after the async broadcast settles, every node
+            # counts the new shard's bit with still zero discovery GETs
+            h[0].client.query("gi", f"Set({7 * SHARD_WIDTH + 9}, gf=1)")
+            time.sleep(0.5)
+            for node in h.nodes:
+                assert node.client.query(
+                    "gi", "Count(Row(gf=1))")["results"] == [7]
+            assert calls["n"] == 0, calls
+        finally:
+            Client.index_shards = orig
+    finally:
+        h.close()
