@@ -46,7 +46,7 @@ def clean_holder(holder, cluster):
                 for shard in list(view.fragments):
                     if cluster.owns_shard(cluster.local_id, idx.name, shard):
                         continue
-                    frag = view.fragments.pop(shard)
+                    frag = view.remove_fragment(shard)
                     frag.close()
                     for p in (frag.path, frag.cache_path):
                         if os.path.exists(p):

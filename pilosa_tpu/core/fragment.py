@@ -96,6 +96,9 @@ class Fragment:
         self._row_cache = {}
         self.generation = 0
         self.uid = next(_fragment_uids)
+        # optional owner hook (View._bump_mutations): lets a container
+        # keep an O(1) any-fragment-changed fingerprint for serving caches
+        self.on_mutate = None
 
         # Block checksums cache (anti-entropy; reference fragment.checksums).
         self._checksums = {}
@@ -559,12 +562,16 @@ class Fragment:
         self._row_cache.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.generation += 1
+        if self.on_mutate is not None:
+            self.on_mutate()
 
     def _invalidate_all_rows(self):
         self._row_cache.clear()
         self._checksums.clear()
         self._mutex_vec = None  # bulk mutation: rebuild lazily
         self.generation += 1
+        if self.on_mutate is not None:
+            self.on_mutate()
 
     # -- anti-entropy blocks (reference: Blocks fragment.go:1778) -------------
 

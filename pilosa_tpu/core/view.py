@@ -5,10 +5,13 @@ Reference: view.go:44. Names: "standard", time-quantum views
 (view.go:27-41).
 """
 
+import itertools
 import os
 import threading
 
 from .fragment import Fragment
+
+_view_uids = itertools.count(1)
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_GROUP_PREFIX = "bsig_"
@@ -31,6 +34,13 @@ class View:
         self.cache_size = cache_size
         self.fragments = {}  # shard -> Fragment
         self._lock = threading.RLock()
+        # O(1) change fingerprint for the stacked serving caches: bumped
+        # on ANY fragment mutation or creation in this view, so a cache
+        # hit costs one counter compare instead of a per-shard generation
+        # walk (exec/stacked.py two-level fingerprint). uid distinguishes
+        # a recreated view (drop + re-create) whose counter restarts.
+        self.uid = next(_view_uids)
+        self.mutations = 0
 
     def open(self):
         frag_dir = os.path.join(self.path, "fragments")
@@ -50,6 +60,17 @@ class View:
             for f in self.fragments.values():
                 f.close()
             self.fragments.clear()
+            self._bump_mutations()
+
+    def remove_fragment(self, shard):
+        """Detach and return one fragment (resize cleanup). Bumps the
+        mutation counter — removal changes what cached serving stacks
+        must contain, exactly like a write (exec/stacked.py stamp)."""
+        with self._lock:
+            frag = self.fragments.pop(shard, None)
+            if frag is not None:
+                self._bump_mutations()
+            return frag
 
     def fragment_path(self, shard):
         return os.path.join(self.path, "fragments", str(shard))
@@ -63,8 +84,16 @@ class View:
             shard, snapshot_queue=self.snapshot_queue, mutexed=self.mutexed,
             cache_type=self.cache_type, cache_size=self.cache_size,
             **kwargs)
+        frag.on_mutate = self._bump_mutations
         self.fragments[shard] = frag
+        self._bump_mutations()
         return frag
+
+    def _bump_mutations(self):
+        # benign-race increment: a stale read in the serving cache means
+        # one extra generation walk, never a stale result (the per-shard
+        # gens remain the ground truth)
+        self.mutations += 1
 
     def fragment(self, shard):
         return self.fragments.get(shard)

@@ -34,6 +34,104 @@ from collections import OrderedDict
 
 import numpy as np
 
+
+class GroupCommit:
+    """Group-commit batching for the serving path (cross-query batching,
+    VERDICT r4 item 5 productionizing bench.py's batching trick).
+
+    Per-query device work is already async — XLA queues each fused
+    program without blocking — but resolving a result costs one full
+    dispatch round trip, and over a remote-device tunnel that RTT (~66 ms
+    measured, BENCH r3) dwarfs device compute (~0.34 ms/query). Serving
+    threads therefore amortize: the first thread to arrive becomes the
+    LEADER and drains everything queued, processing the WHOLE batch with
+    one `process` call (one device_get — or one fused multi-query program
+    + one device_get); threads that arrive while the leader works queue
+    up for the next leader. Leadership transfers by the emptiness rule:
+    whoever appends to an EMPTY queue leads. Zero added latency for a
+    lone query (its leader drains immediately); under concurrency, batch
+    size grows to the natural arrival rate — classic group commit.
+
+    A leader failure (compile error, device OOM, tunnel loss) propagates
+    to EVERY waiter in its batch — events always fire, so no HTTP thread
+    can hang on a dead leader."""
+
+    #: a batch slower than this is RTT-dominated (remote-device tunnel);
+    #: batching windows only engage then
+    RTT_DOMINATED_S = 0.02
+    #: leader pause before draining on RTT-dominated transports — lets
+    #: concurrent queries pile into the batch; small vs the ~66 ms RTT it
+    #: amortizes, and NEVER applied on fast local transports
+    WINDOW_S = 0.005
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._window_s = 0.0  # adaptive: engages once batches measure slow
+        # observability: batches/batched expose the achieved batching
+        # factor (batched/batches ≈ queries per round trip)
+        self.batches = 0
+        self.batched = 0
+
+    def submit(self, payload, process):
+        """Enqueue `payload`; the batch leader calls
+        `process([payloads...]) -> [results...]` once for everything it
+        drained. Returns this payload's result; re-raises the leader's
+        exception if its batch failed."""
+        import time as _time
+
+        entry = [payload, None, None, threading.Event()]
+        with self._lock:
+            self._queue.append(entry)
+            leader = len(self._queue) == 1
+        if not leader:
+            entry[3].wait()
+            if entry[2] is not None:
+                raise entry[2]
+            return entry[1]
+        if self._window_s > 0.0:
+            _time.sleep(self._window_s)
+        with self._lock:
+            batch = self._queue
+            self._queue = []
+        try:
+            t0 = _time.perf_counter()
+            results = process([e[0] for e in batch])
+            elapsed = _time.perf_counter() - t0
+            # adapt: on an RTT-dominated transport a small leader pause
+            # turns the round trip into a shared cost; on a local device
+            # it would only add latency, so keep it off there
+            self._window_s = self.WINDOW_S \
+                if elapsed > self.RTT_DOMINATED_S else 0.0
+            self.batches += 1
+            self.batched += len(batch)
+            for e, r in zip(batch, results):
+                e[1] = r
+        except BaseException as exc:
+            for e in batch:
+                e[2] = exc
+            raise
+        finally:
+            for e in batch:
+                if e is not entry:
+                    e[3].set()
+        return entry[1]
+
+
+def _device_get_batch(payloads):
+    """GroupCommit `process` for plain result fetches: payloads are
+    tuples of device values; ONE device_get resolves them all."""
+    import jax
+
+    flat = [a for arrays in payloads for a in arrays]
+    vals = jax.device_get(flat)
+    out = []
+    i = 0
+    for arrays in payloads:
+        out.append(vals[i:i + len(arrays)])
+        i += len(arrays)
+    return out
+
 from ..core.fragment import BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT
 from ..core.index import EXISTENCE_FIELD_NAME
 from ..core.view import VIEW_STANDARD
@@ -125,6 +223,11 @@ class StackedEvaluator:
         self._rows_stacks = OrderedDict()  # row-chunk pool (own budget)
         self._rows_stack_bytes = 0
         self._fns = OrderedDict()     # kernel signature -> jitted fn
+        # Cross-query batching (GroupCommit): result-fetch amortization
+        # for Sum, and full dispatch batching for Count (queued queries
+        # fuse into ONE program per signature bucket + ONE fetch).
+        self._fetch_commit = GroupCommit()
+        self._count_commit = GroupCommit()
         self._lock = threading.Lock()
         self._sharding = _UNSET
         # Kernel-dispatch counter: tests assert serving dispatch counts are
@@ -249,18 +352,41 @@ class StackedEvaluator:
             return self._rows_stacks, MAX_ROWS_STACK_BYTES
         return self._stacks, MAX_STACK_BYTES
 
-    def _cache_get(self, key, gens):
+    def _cache_get_fast(self, key, stamp):
+        """O(1) hit check via the view-level (uid, mutations) stamp — the
+        first level of the two-level fingerprint. A stamp match proves no
+        fragment in the view changed since the entry was stored, so the
+        per-shard generation walk (954 iterations at 1B columns — the
+        dominant per-query Python cost) is skipped entirely on the hot
+        serving path."""
+        pool, _ = self._pool(key)
+        with self._lock:
+            hit = pool.get(key)
+            if hit is not None and hit[3] == stamp:
+                pool.move_to_end(key)
+                self.hits += 1
+                return hit[1]
+        return None
+
+    def _cache_get(self, key, gens, stamp=None):
+        """Second-level check: exact per-shard generations. On a hit the
+        entry's stamp refreshes — a mutation elsewhere in the view (e.g.
+        a new fragment outside this stack's shard set) bumps the counter
+        without changing these gens, and without the refresh every later
+        query would pay the slow walk again."""
         pool, _ = self._pool(key)
         with self._lock:
             hit = pool.get(key)
             if hit is not None and hit[0] == gens:
                 pool.move_to_end(key)
+                if stamp is not None:
+                    hit[3] = stamp
                 self.hits += 1
                 return hit[1]
             self.misses += 1
         return None
 
-    def _cache_put(self, key, gens, arrays, nbytes):
+    def _cache_put(self, key, gens, arrays, nbytes, stamp=None):
         pool, budget = self._pool(key)
         rows = pool is self._rows_stacks
         with self._lock:
@@ -270,7 +396,7 @@ class StackedEvaluator:
                     self._rows_stack_bytes -= old[2]
                 else:
                     self._stack_bytes -= old[2]
-            pool[key] = (gens, arrays, nbytes)
+            pool[key] = [gens, arrays, nbytes, stamp]
             if rows:
                 self._rows_stack_bytes += nbytes
                 while self._rows_stack_bytes > budget and len(pool) > 1:
@@ -287,16 +413,20 @@ class StackedEvaluator:
     def leaf_stack(self, idx, field_name, row_id, shards):
         """Cached [S, W] device stack of one row over `shards`."""
         key = ("leaf", idx.name, field_name, row_id, shards)
-        gens = self._fragment_gens(idx, field_name, shards)
-        if gens is None:
-            return None
-        hit = self._cache_get(key, gens)
-        if hit is not None:
-            return hit
         field = idx.field(field_name)
         view = field.view(VIEW_STANDARD) if field is not None else None
         if view is None:
             return None
+        hit = self._cache_get_fast(key, (view.uid, view.mutations))
+        if hit is not None:
+            return hit
+        stamp = (view.uid, view.mutations)
+        gens = self._fragment_gens(idx, field_name, shards)
+        if gens is None:
+            return None
+        hit = self._cache_get(key, gens, stamp)
+        if hit is not None:
+            return hit
         # Incremental maintenance: when k << S shards drifted (a write
         # bumps only its fragment's generation), gather + upload ONLY
         # those planes and scatter them into the cached device stack —
@@ -316,11 +446,11 @@ class StackedEvaluator:
                     stale[1].at[np.asarray(changed)].set(
                         jnp.asarray(block[0])), shard_axis=0)
                 self.patches += 1
-                self._cache_put(key, gens, stack, stack.size * 4)
+                self._cache_put(key, gens, stack, stack.size * 4, stamp)
                 return stack
         host = self._host_rows(view, [row_id], shards)
         stack = self._place(host[0], shard_axis=0)
-        self._cache_put(key, gens, stack, stack.size * 4)
+        self._cache_put(key, gens, stack, stack.size * 4, stamp)
         return stack
 
     def _host_rows(self, view, row_ids, shards, pad=True):
@@ -369,16 +499,21 @@ class StackedEvaluator:
         the full candidate set exceeds the rows pool, so oversized scans
         don't churn out every reusable chunk."""
         key = ("rows", idx.name, field_name, view_name, row_chunk, shards)
-        gens = self._fragment_gens(idx, field_name, shards, view_name)
-        if gens is None:
-            return None
-        hit = self._cache_get(key, gens)
-        if hit is not None:
-            return hit
         field = idx.field(field_name)
         view = field.view(view_name) if field is not None else None
         if view is None:
             return None
+        if cache:
+            hit = self._cache_get_fast(key, (view.uid, view.mutations))
+            if hit is not None:
+                return hit
+        stamp = (view.uid, view.mutations)
+        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        if gens is None:
+            return None
+        hit = self._cache_get(key, gens, stamp if cache else None)
+        if hit is not None:
+            return hit
         if cache:
             stale = self._stale_entry(key, gens)
             if stale is not None:
@@ -393,12 +528,13 @@ class StackedEvaluator:
                         stale[1].at[:, np.asarray(changed)].set(
                             jnp.asarray(block)), shard_axis=1)
                     self.patches += 1
-                    self._cache_put(key, gens, stack, stack.size * 4)
+                    self._cache_put(key, gens, stack, stack.size * 4,
+                                    stamp)
                     return stack
         host = self._host_rows(view, list(row_chunk), shards)
         stack = self._place(host, shard_axis=1)
         if cache:
-            self._cache_put(key, gens, stack, stack.size * 4)
+            self._cache_put(key, gens, stack, stack.size * 4, stamp)
         return stack
 
     def bsi_stack(self, idx, field_name, shards):
@@ -411,15 +547,19 @@ class StackedEvaluator:
         view_name = field.bsi_view_name()
         depth = field.options.bit_depth
         key = ("bsi", idx.name, field_name, depth, shards)
-        gens = self._fragment_gens(idx, field_name, shards, view_name)
-        if gens is None:
-            return None
-        hit = self._cache_get(key, gens)
-        if hit is not None:
-            return hit
         view = field.view(view_name)
         if view is None:
             return None
+        hit = self._cache_get_fast(key, (view.uid, view.mutations))
+        if hit is not None:
+            return hit
+        stamp = (view.uid, view.mutations)
+        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        if gens is None:
+            return None
+        hit = self._cache_get(key, gens, stamp)
+        if hit is not None:
+            return hit
         rows = [BSI_EXISTS_BIT, BSI_SIGN_BIT] + [
             BSI_OFFSET_BIT + i for i in range(depth)]
         stale = self._stale_entry(key, gens)
@@ -440,12 +580,12 @@ class StackedEvaluator:
                                 shard_axis=0),
                 )
                 self.patches += 1
-                self._cache_put(key, gens, arrays, stale[2])
+                self._cache_put(key, gens, arrays, stale[2], stamp)
                 return arrays
         host = self._host_rows(view, rows, shards)
         arr = self._place(host, shard_axis=1)
         arrays = (arr[2:], arr[1], arr[0])  # planes, sign, exists
-        self._cache_put(key, gens, arrays, arr.size * 4)
+        self._cache_put(key, gens, arrays, arr.size * 4, stamp)
         return arrays
 
     def bsi_condition_stack(self, idx, key, shards):
@@ -543,6 +683,82 @@ class StackedEvaluator:
             return fn
 
         return self._get_fn(("count", sig, arity), build)
+
+    def _count_batch_fn(self, sig, arity, batch):
+        """`batch` independent count trees of one signature fused into ONE
+        program: args are batch*arity leaf stacks, outputs are [batch]
+        (hi, lo) vectors. This is bench.py's batched-serving trick
+        productionized (VERDICT r3 item 5): one dispatch + one fetch
+        amortize the per-query round trip across every concurrent query."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            @jax.jit
+            def fn(*all_stacks):
+                his, los = [], []
+                for q in range(batch):
+                    stacks = all_stacks[q * arity:(q + 1) * arity]
+                    acc = self._tree_eval(sig, stacks)
+                    per_shard = jnp.sum(
+                        jax.lax.population_count(acc).astype(jnp.int32),
+                        axis=-1)
+                    hi, lo = bitplane.hi_lo(per_shard)
+                    his.append(hi)
+                    los.append(lo)
+                return jnp.stack(his), jnp.stack(los)
+
+            return fn
+
+        return self._get_fn(("countB", sig, arity, batch), build)
+
+    #: count-batcher buckets: batch sizes are rounded up to a power of two
+    #: (padding repeats the first query) so at most log2(MAX) programs
+    #: compile per signature; 32 keeps device time per dispatch (~11 ms at
+    #: 954 shards) under the tunnel RTT it amortizes
+    MAX_COUNT_BATCH = 32
+
+    def _batched_count(self, sig, stacks):
+        """Group-commit count execution: the batch leader drains every
+        queued count query, groups them by signature, runs one fused
+        program per group (power-of-two bucket, padded by repeating the
+        first query), fetches ALL results in one transfer, and
+        distributes. Solo queries pay nothing extra; leader failures
+        propagate to every waiter (GroupCommit contract)."""
+        return self._count_commit.submit(
+            (sig, tuple(stacks)), self._process_count_batch)
+
+    def _process_count_batch(self, payloads):
+        """GroupCommit `process` for count queries: payloads are
+        (sig, stacks) pairs; returns their counts in order."""
+        import jax
+
+        groups = {}
+        for pos, (sig, stacks) in enumerate(payloads):
+            groups.setdefault((sig, len(stacks)), []).append(pos)
+        outs = []
+        for (sig_g, arity), positions in groups.items():
+            for i in range(0, len(positions), self.MAX_COUNT_BATCH):
+                chunk = positions[i:i + self.MAX_COUNT_BATCH]
+                size = 1 << (len(chunk) - 1).bit_length()
+                fn = self._count_batch_fn(sig_g, arity, size)
+                args = []
+                for pos in chunk:
+                    args.extend(payloads[pos][1])
+                for _ in range(size - len(chunk)):
+                    args.extend(payloads[chunk[0]][1])  # pad: repeat q0
+                his, los = fn(*args)
+                outs.append((chunk, his, los))
+        flat = [a for _, h, l in outs for a in (h, l)]
+        vals = jax.device_get(flat)  # ONE transfer for everything
+        results = [None] * len(payloads)
+        i = 0
+        for chunk, _, _ in outs:
+            his, los = vals[i], vals[i + 1]
+            i += 2
+            for q, pos in enumerate(chunk):
+                results[pos] = combine_hi_lo(his[q], los[q])
+        return results
 
     def _plane_fn(self, sig, arity):
         """Tree -> combined [S, W] plane stack (filter materialization)."""
@@ -688,8 +904,9 @@ class StackedEvaluator:
             return None
         sig, stacks = gathered
         self.dispatches += 1
-        hi, lo = self._count_fn(sig, len(stacks))(*stacks)
-        return combine_hi_lo(hi, lo)
+        # group-commit execution: concurrent count queries fuse into one
+        # program + one result round trip (see _batched_count)
+        return self._batched_count(sig, stacks)
 
     def filter_stack(self, idx, call, shards):
         """Materialize a bitmap call tree as one [S, W] device stack.
@@ -763,7 +980,8 @@ class StackedEvaluator:
             res = fn(planes, sign, exists, filt)
         else:
             res = fn(planes, sign, exists)
-        p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = [np.asarray(r) for r in res]
+        p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = \
+            self._fetch_commit.submit(tuple(res), _device_get_batch)
         pos = combine_hi_lo(p_hi, p_lo)
         neg = combine_hi_lo(n_hi, n_lo)
         total = 0
@@ -811,6 +1029,10 @@ class StackedEvaluator:
                 "patches": self.patches,
                 "planes_uploaded": self.planes_uploaded,
                 "dispatches": self.dispatches,
+                "group_fetches": self._fetch_commit.batches,
+                "group_fetched_queries": self._fetch_commit.batched,
+                "count_batches": self._count_commit.batches,
+                "count_batched_queries": self._count_commit.batched,
                 "stack_bytes": self._stack_bytes,
                 "stack_entries": len(self._stacks),
                 "rows_stack_bytes": self._rows_stack_bytes,

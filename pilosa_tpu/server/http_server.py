@@ -434,7 +434,14 @@ class PilosaHTTPServer:
 
             do_GET = do_POST = do_DELETE = _dispatch
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        # Stdlib default listen backlog is 5: a burst of concurrent
+        # clients (the serving workload the batched count path exists
+        # for) overflows it and the kernel RESETS the excess connects.
+        # 128 matches common production server defaults.
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._httpd = _Server((self.host, self.port), Handler)
         if self.tls_cert:
             import ssl
 

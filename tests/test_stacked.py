@@ -132,6 +132,6 @@ def test_stacks_sharded_over_devices(setup):
     holder, api = setup
     ex = Executor(holder)
     assert ex.execute("st", "Count(Row(f=1))")[0] > 0
-    (_, stack, _), = list(ex._stacked._stacks.values())
+    (_, stack, _, _), = list(ex._stacked._stacks.values())
     assert len(stack.sharding.device_set) == len(jax.devices())
     assert stack.shape[0] % len(jax.devices()) == 0  # zero-padded
