@@ -18,10 +18,17 @@ import itertools
 import os
 import hashlib
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
 _fragment_uids = itertools.count(1)
+
+# Cross-fragment LRU of resident mutex rows-vectors (~8 MB each; see
+# Fragment._mutex_vector). 64 bounds worst-case host RAM at ~512 MB.
+_MUTEX_VECTOR_CAP = 64
+_MUTEX_VECTOR_LOCK = threading.Lock()
+_MUTEX_VECTORS = OrderedDict()
 
 from ..roaring import (
     Bitmap,
@@ -161,6 +168,7 @@ class Fragment:
                 self._file.close()
                 self._file = None
             self._row_cache.clear()
+        self._drop_mutex_vec()
 
     @property
     def is_open(self):
@@ -187,8 +195,12 @@ class Fragment:
         pos = self.pos(row_id, column_id)
         changed = self.storage.add(pos)
         if changed:
-            if self.mutexed and self._mutex_vec is not None:
-                self._mutex_vec[column_id % SHARD_WIDTH] = row_id
+            # local ref: a concurrent LRU eviction may null the attribute
+            # mid-write; mutating the discarded array is harmless (the
+            # rebuild re-reads storage)
+            vec = self._mutex_vec
+            if self.mutexed and vec is not None:
+                vec[column_id % SHARD_WIDTH] = row_id
             self._append_op(encode_op(OP_ADD, value=pos))
             self._invalidate_row(row_id)
             self._cache_update(row_id)
@@ -202,10 +214,11 @@ class Fragment:
         pos = self.pos(row_id, column_id)
         changed = self.storage.remove(pos)
         if changed:
-            if self.mutexed and self._mutex_vec is not None:
+            vec = self._mutex_vec  # local ref: see _set_bit_locked
+            if self.mutexed and vec is not None:
                 off = column_id % SHARD_WIDTH
-                if int(self._mutex_vec[off]) == row_id:
-                    self._mutex_vec[off] = -1
+                if int(vec[off]) == row_id:
+                    vec[off] = -1
             self._append_op(encode_op(OP_REMOVE, value=pos))
             self._invalidate_row(row_id)
             self._cache_update(row_id)
@@ -218,6 +231,15 @@ class Fragment:
         if existing is not None and existing != row_id:
             self._clear_bit_locked(existing, column_id)
 
+    def _drop_mutex_vec(self):
+        """Null the rows-vector AND release its LRU slot — a
+        vector-less fragment left registered would consume cap budget and
+        evict live vectors (close() and every bulk-invalidation route
+        through here)."""
+        self._mutex_vec = None
+        with _MUTEX_VECTOR_LOCK:
+            _MUTEX_VECTORS.pop(self.uid, None)
+
     def _mutex_vector(self):
         """The mutex rows-vector (column offset -> row id, int64 array of
         SHARD_WIDTH with -1 = unset, ~8 MB/fragment), built lazily with one
@@ -226,7 +248,16 @@ class Fragment:
         it). O(1) lookups replace the per-write all-rows probe (reference:
         rowsVector fragment.go:3102, boltRowsVector). Mutex fragments only
         — non-mutexed fragments have no single-row-per-column invariant
-        and their writes don't maintain the vector."""
+        and their writes don't maintain the vector.
+
+        Resident vectors are LRU-bounded ACROSS fragments
+        (_MUTEX_VECTOR_CAP): a node holding hundreds of mutex fragments
+        that each saw one write must not pin hundreds x 8 MB of host RAM.
+        Eviction is a plain cross-thread `_mutex_vec = None` — safe
+        because the vector is a pure cache of storage and every user
+        holds a LOCAL reference under its own fragment lock (a lost
+        update to a discarded array is harmless; the rebuild re-reads
+        storage)."""
         vec = self._mutex_vec
         if vec is None:
             # int64: row ids range to ~2^44 (pos() is uint64); int32 would
@@ -239,6 +270,12 @@ class Fragment:
                 ).astype(np.int64)
                 vec[offs] = row_id
             self._mutex_vec = vec
+        with _MUTEX_VECTOR_LOCK:
+            _MUTEX_VECTORS[self.uid] = self
+            _MUTEX_VECTORS.move_to_end(self.uid)
+            while len(_MUTEX_VECTORS) > _MUTEX_VECTOR_CAP:
+                _, victim = _MUTEX_VECTORS.popitem(last=False)
+                victim._mutex_vec = None  # rebuilt lazily on next use
         return vec
 
     def row_for_column(self, column_id):
@@ -516,7 +553,7 @@ class Fragment:
             self._append_op(encode_op(
                 OP_ADD_ROARING, roaring=serialize(row_bitmap), op_n=0))
             self._invalidate_row(row_id)
-            self._mutex_vec = None  # whole-row overwrite: rebuild lazily
+            self._drop_mutex_vec()  # whole-row overwrite: rebuild lazily
             self._cache_update(row_id)
             return True
 
@@ -568,7 +605,7 @@ class Fragment:
     def _invalidate_all_rows(self):
         self._row_cache.clear()
         self._checksums.clear()
-        self._mutex_vec = None  # bulk mutation: rebuild lazily
+        self._drop_mutex_vec()  # bulk mutation: rebuild lazily
         self.generation += 1
         if self.on_mutate is not None:
             self.on_mutate()

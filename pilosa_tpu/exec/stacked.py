@@ -68,6 +68,11 @@ class GroupCommit:
         self._lock = threading.Lock()
         self._queue = []
         self._window_s = 0.0  # adaptive: engages once batches measure slow
+        # Minimum observed batch latency ~ the transport's round-trip
+        # floor: a large batch is slow everywhere, but only a transport
+        # whose FASTEST batch is still slow is RTT-dominated. Keying the
+        # window on the min keeps it off local devices even under bursts.
+        self._min_elapsed_s = float("inf")
         # observability: batches/batched expose the achieved batching
         # factor (batched/batches ≈ queries per round trip)
         self.batches = 0
@@ -101,8 +106,9 @@ class GroupCommit:
             # adapt: on an RTT-dominated transport a small leader pause
             # turns the round trip into a shared cost; on a local device
             # it would only add latency, so keep it off there
+            self._min_elapsed_s = min(self._min_elapsed_s, elapsed)
             self._window_s = self.WINDOW_S \
-                if elapsed > self.RTT_DOMINATED_S else 0.0
+                if self._min_elapsed_s > self.RTT_DOMINATED_S else 0.0
             self.batches += 1
             self.batched += len(batch)
             for e, r in zip(batch, results):
@@ -328,16 +334,19 @@ class StackedEvaluator:
     # -- stack cache ---------------------------------------------------------
 
     def _fragment_gens(self, idx, field_name, shards,
-                       view_name=VIEW_STANDARD):
+                       view_name=VIEW_STANDARD, view=None):
         """Cache-validation fingerprint: per-shard (fragment uid,
         generation). The uid makes a recreated fragment (field dropped and
         re-made at the same path) distinct from its predecessor even when
         the generation counters collide. None when the field vanished
-        (concurrent DDL) — caller falls back to the general path."""
-        field = idx.field(field_name)
-        view = field.view(view_name) if field is not None else None
+        (concurrent DDL) — caller falls back to the general path. Callers
+        that already resolved the view pass it to skip the double
+        field/view lookup on the serving path."""
         if view is None:
-            return None
+            field = idx.field(field_name)
+            view = field.view(view_name) if field is not None else None
+            if view is None:
+                return None
         gens = []
         for shard in shards:
             frag = view.fragment(shard)
@@ -421,7 +430,7 @@ class StackedEvaluator:
         if hit is not None:
             return hit
         stamp = (view.uid, view.mutations)
-        gens = self._fragment_gens(idx, field_name, shards)
+        gens = self._fragment_gens(idx, field_name, shards, view=view)
         if gens is None:
             return None
         hit = self._cache_get(key, gens, stamp)
@@ -508,7 +517,8 @@ class StackedEvaluator:
             if hit is not None:
                 return hit
         stamp = (view.uid, view.mutations)
-        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        gens = self._fragment_gens(idx, field_name, shards, view_name,
+                                   view=view)
         if gens is None:
             return None
         hit = self._cache_get(key, gens, stamp if cache else None)
@@ -554,7 +564,8 @@ class StackedEvaluator:
         if hit is not None:
             return hit
         stamp = (view.uid, view.mutations)
-        gens = self._fragment_gens(idx, field_name, shards, view_name)
+        gens = self._fragment_gens(idx, field_name, shards, view_name,
+                                   view=view)
         if gens is None:
             return None
         hit = self._cache_get(key, gens, stamp)
@@ -741,7 +752,13 @@ class StackedEvaluator:
             for i in range(0, len(positions), self.MAX_COUNT_BATCH):
                 chunk = positions[i:i + self.MAX_COUNT_BATCH]
                 size = 1 << (len(chunk) - 1).bit_length()
-                fn = self._count_batch_fn(sig_g, arity, size)
+                if size == 1:
+                    # solo query: reuse the plain count program (shared
+                    # with warm pre-batching traffic) instead of
+                    # compiling an identical batch-1 variant
+                    fn = self._count_fn(sig_g, arity)
+                else:
+                    fn = self._count_batch_fn(sig_g, arity, size)
                 args = []
                 for pos in chunk:
                     args.extend(payloads[pos][1])
@@ -754,7 +771,8 @@ class StackedEvaluator:
         results = [None] * len(payloads)
         i = 0
         for chunk, _, _ in outs:
-            his, los = vals[i], vals[i + 1]
+            # atleast_1d: the solo path returns 0-d scalars
+            his, los = np.atleast_1d(vals[i]), np.atleast_1d(vals[i + 1])
             i += 2
             for q, pos in enumerate(chunk):
                 results[pos] = combine_hi_lo(his[q], los[q])

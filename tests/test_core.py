@@ -447,3 +447,29 @@ def test_mutex_rows_vector_large_row_id(tmp_path):
     assert f.row_for_column(5) == big
     assert not f.contains(1, 5)
     f.close()
+
+
+def test_mutex_vector_lru_bounded(tmp_path, monkeypatch):
+    """Resident mutex rows-vectors are LRU-bounded across fragments
+    (~8 MB each): touching many mutex fragments must not pin a vector per
+    fragment forever."""
+    from pilosa_tpu.core import fragment as fragment_mod
+    from pilosa_tpu.core.field import FieldOptions
+
+    monkeypatch.setattr(fragment_mod, "_MUTEX_VECTOR_CAP", 2)
+    holder = Holder(str(tmp_path / "mvec")).open()
+    idx = holder.create_index("i")
+    f = idx.create_field("m", FieldOptions(type="mutex"))
+    frags = []
+    for shard in range(4):
+        col = shard * SHARD_WIDTH + 5
+        f.set_bit(1, col)
+        f.set_bit(2, col)  # mutex overwrite exercises the vector
+        frag = f.view("standard").fragment(shard)
+        assert frag.row_for_column(col) == 2
+        frags.append(frag)
+    resident = [fr for fr in frags if fr._mutex_vec is not None]
+    assert len(resident) <= 2, [fr.shard for fr in resident]
+    # evicted vectors rebuild lazily and stay correct
+    assert frags[0].row_for_column(0 * SHARD_WIDTH + 5) == 2
+    holder.close()
