@@ -973,10 +973,15 @@ class StackedEvaluator:
                 # candidate set queued in flight.
                 jax.block_until_ready(hi_lo)
             pending.append((chunk, hi_lo))
-        for chunk, (hi, lo) in pending:
-            totals = combine_hi_lo(hi, lo)
-            for j, row_id in enumerate(chunk):
-                out[row_id] = int(totals[j])
+        # ONE amortized fetch for every chunk's (hi, lo) pair — shared
+        # with concurrently-serving queries via the group commit
+        flat = tuple(a for _, hl in pending for a in hl)
+        if flat:
+            vals = self._fetch_commit.submit(flat, _device_get_batch)
+            for k, (chunk, _) in enumerate(pending):
+                totals = combine_hi_lo(vals[2 * k], vals[2 * k + 1])
+                for j, row_id in enumerate(chunk):
+                    out[row_id] = int(totals[j])
         return out
 
     def try_sum(self, idx, field, filter_call, shards):
@@ -1024,9 +1029,12 @@ class StackedEvaluator:
         fn = self._minmax_fn(filt is not None, is_max)
         self.dispatches += 1
         if filt is not None:
-            empty, use_neg, bits, c_hi, c_lo = fn(planes, sign, exists, filt)
+            res = fn(planes, sign, exists, filt)
         else:
-            empty, use_neg, bits, c_hi, c_lo = fn(planes, sign, exists)
+            res = fn(planes, sign, exists)
+        # amortized result fetch (group commit, like try_sum)
+        empty, use_neg, bits, c_hi, c_lo = \
+            self._fetch_commit.submit(tuple(res), _device_get_batch)
         if bool(empty):
             return None, 0
         bits = np.asarray(bits)

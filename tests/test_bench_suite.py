@@ -39,3 +39,24 @@ def test_bsi_range_sum_config_runs():
 
     _run(bench_suite.bench_bsi_range_sum,
          "bsi_range_sum_timeviews_range_qps")
+
+
+def test_served_1b_config_runs():
+    """conftest pins tests to CPU (32 shards -> 33M cols); don't hardcode
+    the scale suffix in case this ever runs against an accelerator."""
+    import json
+    import io
+    import sys
+
+    import bench_suite
+
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        bench_suite.bench_served_1b()
+    finally:
+        sys.stdout = old
+    out = json.loads(buf.getvalue().strip())
+    assert out["metric"].startswith("served_intersect_count_qps_")
+    assert out["value"] > 0
