@@ -190,6 +190,11 @@ def cmd_server(args):
     # flag wins over config file; unset disables the log.
     lqt = getattr(args, "long_query_time", None) \
         or config.get("long-query-time")
+    # write-batch cap (reference: max-writes-per-request
+    # server/config.go); <=0 disables
+    mwpr = getattr(args, "max_writes_per_request", None)
+    if mwpr is None:
+        mwpr = config.get("max-writes-per-request", 0)
     spmd = None
     if spmd_requested and cluster is not None:
         from .cluster.spmd import SpmdDataPlane
@@ -201,6 +206,7 @@ def cmd_server(args):
                              logger=StandardLogger())
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
+              max_writes_per_request=int(mwpr),
               spmd=spmd)
     anti_entropy = None
     translate_repl = None
@@ -616,6 +622,10 @@ def main(argv=None):
     p.add_argument("--long-query-time", default=None,
                    help="log queries slower than this duration "
                         "(e.g. 500ms, 2s); disabled when unset")
+    p.add_argument("--max-writes-per-request", type=int, default=None,
+                   help="reject queries with more than this many write "
+                        "calls (reference: max-writes-per-request); "
+                        "<=0 disables")
     p.add_argument("--stats", default=None,
                    choices=["local", "statsd", "none"],
                    help="metrics backend (default local registry; statsd "

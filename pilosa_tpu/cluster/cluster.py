@@ -181,10 +181,20 @@ class Cluster:
         (invalidate_shard_map / drop_remote_index)."""
         import time as _time
 
+        with self._lock:  # RLock: record nests under the same lock
+            self.record_remote_shards(node_id, index, shards)
+            self._shards_synced[(node_id, index)] = _time.monotonic()
+
+    def record_remote_shards(self, node_id, index, shards):
+        """Union shards into a peer's map WITHOUT marking it seeded:
+        used by the write path for read-your-writes — a node that just
+        forwarded an import slice KNOWS the target now holds that shard
+        and must not wait for the target's async push (which can lag the
+        ack and leave an immediate query silently missing the shard).
+        The seed fetch still runs for peers never fully synced."""
         with self._lock:
             self._remote_shards.setdefault(node_id, {}).setdefault(
                 index, set()).update(int(s) for s in shards)
-            self._shards_synced[(node_id, index)] = _time.monotonic()
 
     def shards_synced(self, node_id, index):
         import time as _time

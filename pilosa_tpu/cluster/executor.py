@@ -175,7 +175,7 @@ class ClusterExecutor:
     to purely local execution."""
 
     def __init__(self, holder, cluster, client_factory, spmd=None,
-                 logger=None):
+                 logger=None, max_writes_per_request=0):
         from ..utils.logger import NopLogger
 
         self.holder = holder
@@ -183,7 +183,8 @@ class ClusterExecutor:
         self.client_factory = client_factory
         self.spmd = spmd
         self.logger = logger or NopLogger()
-        self.local = Executor(holder)
+        self.local = Executor(
+            holder, max_writes_per_request=max_writes_per_request)
 
     # -- public entry --------------------------------------------------------
 
@@ -194,15 +195,23 @@ class ClusterExecutor:
         if isinstance(query, str):
             query = parse(query)
         opt = options or ExecOptions()
+        from ..exec.executor import check_write_limit
+
+        check_write_limit(query, self.local.max_writes_per_request)
 
         if self.cluster is None or len(self.cluster.nodes) <= 1 or opt.remote:
             # single-node, or we ARE the remote: pure local execution
             return self.local.execute(index_name, query, shards=shards,
                                       options=opt)
 
+        from ..exec.executor import validate_uint_args
         from ..exec.translate import translate_calls, translate_results
 
         translate_calls(idx, query.calls)
+        # negative-arg rejection AFTER translation (keyed args become
+        # ints) and BEFORE the SPMD fast path, which reads args raw
+        for c in query.calls:
+            validate_uint_args(c)
         # fetch the cluster-wide shard list ONCE per query, not per call
         if shards is None and any(not c.writes() for c in query.calls):
             shards = self.cluster_shards(idx)
@@ -251,6 +260,13 @@ class ClusterExecutor:
                     out = resp["results"][0]
                     ret = ret or bool(out)
                     ok += 1
+                    # read-your-writes for shard discovery: the owner just
+                    # acked this shard; don't wait for its async push.
+                    # Set only — Clear never materializes a fragment, so
+                    # recording it would register a phantom shard.
+                    if call.name == "Set":
+                        self.cluster.record_remote_shards(
+                            node.id, idx.name, [shard])
                 except Exception as e:
                     errors.append((node.id, e))
         if ok == 0:

@@ -501,7 +501,14 @@ class Fragment:
 
     def row_ids(self):
         """Sorted rowIDs with any bit set (reference: fragment.rows),
-        memoized per write-generation (mutex set_bit probes this per write)."""
+        memoized per write-generation (mutex set_bit probes this per write).
+
+        The lock-free fast path is a deliberate exception to this file's
+        readers-take-the-lock discipline: the (gen, ids) TUPLE is
+        published atomically by CPython reference assignment, so a racing
+        reader sees either the old pair or the new pair, never a torn
+        one; a stale pair fails the generation compare and falls to the
+        locked rebuild."""
         cached = self._row_ids_cache
         if cached is not None and cached[0] == self.generation:
             return cached[1]

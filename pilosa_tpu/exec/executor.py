@@ -52,6 +52,59 @@ class ExecOptions:
         self.profile = profile
 
 
+def uint_arg(call, key):
+    """(value, present) for a non-negative integer argument; rejects
+    negatives with the reference's message (pql.Call.UintArg
+    pql/ast.go:315: "value for 'x' must be positive, but got -1" — the
+    reference errors rather than silently serving an empty result)."""
+    val = call.args.get(key)
+    if val is None:
+        return 0, False
+    if isinstance(val, bool) or not isinstance(val, int):
+        raise ExecError(
+            f"could not convert {val!r} to an unsigned integer "
+            f"for '{key}'")
+    if val < 0:
+        raise ExecError(
+            f"value for '{key}' must be positive, but got {val}")
+    return val, True
+
+
+def check_write_limit(query, max_writes):
+    """(reference: executor.Execute executor.go:135 + ErrTooManyWrites)"""
+    if max_writes and max_writes > 0:
+        n = sum(1 for c in query.calls if c.writes())
+        if n > max_writes:
+            raise ExecError("too many write commands")
+
+
+#: unsigned-integer argument names validated per CALL NAME (the
+#: reference rejects negatives via Call.UintArg exactly where these are
+#: read; Shift's `n` is deliberately absent — it is a signed IntArg,
+#: executor.go:1770)
+_UINT_ARGS_BY_CALL = {
+    "TopN": ("n", "threshold", "tanimotoThreshold"),
+    "Rows": ("limit", "previous", "column"),
+    "GroupBy": ("limit", "offset"),
+}
+
+
+def validate_uint_args(call):
+    """Recursive negative-argument rejection for a whole call tree. Runs
+    at the COORDINATOR entry (cluster executor, AFTER key translation) as
+    well as inside the local executor, so fast paths that read args raw —
+    the SPMD collective plane in particular — can never serve a silently
+    wrong slice for a negative n/limit/offset."""
+    for key in _UINT_ARGS_BY_CALL.get(call.name, ()):
+        if key in call.args:
+            uint_arg(call, key)
+    for child in call.children:
+        validate_uint_args(child)
+    filt = call.args.get("filter")
+    if isinstance(filt, Call):
+        validate_uint_args(filt)
+
+
 def fragment_topn_candidates(frag, use_cache=True):
     """THE per-fragment TopN candidate policy: cache ids when a cache is
     populated (the reference's approximation), else every present row.
@@ -65,10 +118,13 @@ class Executor:
     """Single-node executor over a Holder. The cluster layer (parallel/)
     wraps this with shard->node fan-out."""
 
-    def __init__(self, holder):
+    def __init__(self, holder, max_writes_per_request=0):
         from .stacked import StackedEvaluator
 
         self.holder = holder
+        # reject write batches past this many write calls; <=0 = unlimited
+        # (reference: Executor.MaxWritesPerRequest executor.go:55)
+        self.max_writes_per_request = max_writes_per_request
         self._stacked = StackedEvaluator()
 
     def stacked_stats(self):
@@ -88,6 +144,7 @@ class Executor:
         if isinstance(query, str):
             query = parse(query)
         opt = options or ExecOptions()
+        check_write_limit(query, self.max_writes_per_request)
 
         # Key translation happens only on the coordinating node; remote
         # shards always receive integer IDs (reference: executor.go:2610).
@@ -632,10 +689,12 @@ class Executor:
             raise ExecError("TopN() can only have one input bitmap")
         if call.children:
             self.validate_bitmap_call(idx, call.children[0])
-        n = call.args.get("n")
+        n_val, has_n = uint_arg(call, "n")
+        n = n_val if has_n else None
         ids = call.args.get("ids")
-        threshold = int(call.args.get("threshold") or 1)
-        tanimoto = int(call.args.get("tanimotoThreshold") or 0)
+        thr, has_thr = uint_arg(call, "threshold")
+        threshold = thr if has_thr else 1
+        tanimoto, _ = uint_arg(call, "tanimotoThreshold")
         if tanimoto > 100 or tanimoto < 0:
             raise ExecError("Tanimoto Threshold is from 1 to 100 only")
         if tanimoto > 0 and not call.children:
@@ -837,9 +896,12 @@ class Executor:
     def _exec_rows(self, idx, call, shards, opt):
         """(reference: executeRows executor.go:1280)"""
         field = self._set_field(idx, call)
-        limit = call.args.get("limit")
-        previous = call.args.get("previous")
-        column = call.args.get("column")
+        limit_val, has_limit = uint_arg(call, "limit")
+        limit = limit_val if has_limit else None
+        prev_val, has_prev = uint_arg(call, "previous")
+        previous = prev_val if has_prev else None
+        col_val, has_col = uint_arg(call, "column")
+        column = col_val if has_col else None
 
         rows = set()
         shard_list = self._call_shards(idx, shards)
@@ -878,7 +940,9 @@ class Executor:
         for child in call.children:
             if child.name != "Rows":
                 raise ExecError("GroupBy children must be Rows() calls")
-        limit = call.args.get("limit")
+        limit_val, has_limit = uint_arg(call, "limit")
+        limit = limit_val if has_limit else None
+        offset_val, has_offset = uint_arg(call, "offset")
         filter_call = call.args.get("filter")
         if filter_call is not None:
             if not isinstance(filter_call, Call):
@@ -912,10 +976,8 @@ class Executor:
         # offset applies after the limit-bounded merge, and is a NO-OP
         # when it reaches past the result set (reference guards
         # `offset < len(results)`: executeGroupBy executor.go:1134-1143)
-        offset = call.args.get("offset")
-        if offset is not None and not opt.remote \
-                and int(offset) < len(out):
-            out = out[int(offset):]
+        if has_offset and not opt.remote and offset_val < len(out):
+            out = out[offset_val:]
         return out
 
     def _group_by_stacked(self, idx, fields, child_rows, filter_call,

@@ -106,7 +106,8 @@ def result_to_json(result):
 
 class API:
     def __init__(self, holder, cluster=None, client_factory=None,
-                 long_query_time=None, logger=None, spmd=None):
+                 long_query_time=None, logger=None, spmd=None,
+                 max_writes_per_request=0):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
@@ -127,15 +128,18 @@ class API:
         if cluster is not None:
             from ..cluster import ResizeManager
 
-            self.executor = ClusterExecutor(holder, cluster, client_factory,
-                                            spmd=spmd, logger=self.logger)
+            self.executor = ClusterExecutor(
+                holder, cluster, client_factory, spmd=spmd,
+                logger=self.logger,
+                max_writes_per_request=max_writes_per_request)
             if spmd is not None:
                 # share the serving executor for SPMD condition-leaf
                 # evaluation instead of building a second evaluator
                 spmd._local_exec = self.executor.local
             self.resize = ResizeManager(holder, cluster, self.client_factory)
         else:
-            self.executor = Executor(holder)
+            self.executor = Executor(
+                holder, max_writes_per_request=max_writes_per_request)
             self.resize = None
 
     def spmd_step(self, step):
@@ -404,7 +408,8 @@ class API:
         remotes = [n for n in owners if n.id != self.cluster.local_id]
         return local, remotes
 
-    def _fan_out_writes(self, jobs, covered_locally, count_shards=()):
+    def _fan_out_writes(self, jobs, covered_locally, count_shards=(),
+                        index_name=None):
         """Run remote import forwards (one worker per TARGET NODE, its jobs
         sequential — bounded like the executor's per-node mapReduce fan-out)
         and apply the degraded-write policy.
@@ -479,6 +484,14 @@ class API:
             self.logger.printf(
                 "import: replica %s unreachable for shard %d (%s); "
                 "anti-entropy will repair", node_id, shard, e)
+        # read-your-writes for shard discovery: this node just confirmed
+        # these shards landed on these peers — record them now instead of
+        # waiting for the peers' async CREATE_SHARD pushes (which can lag
+        # the ack and leave an immediate query missing a fresh shard)
+        if self.cluster is not None and index_name is not None:
+            for (shard, node_id) in results:
+                self.cluster.record_remote_shards(
+                    node_id, index_name, [shard])
         remote_changed = {s: 0 for s in count_shards}
         for (shard, _), resp in results.items():
             if shard in remote_changed and isinstance(resp, dict):
@@ -537,7 +550,8 @@ class API:
                         index_name, field_name, r.tolist(), c.tolist(),
                         timestamps=w, clear=clear, remote=True))))
         _, remote_changed = self._fan_out_writes(
-            jobs, covered, count_shards=remote_only)
+            jobs, covered, count_shards=remote_only,
+            index_name=index_name)
         self._broadcast_shards_if_changed(index_name)
         return changed + remote_changed
 
@@ -576,7 +590,8 @@ class API:
                         index_name, field_name, c.tolist(), v.tolist(),
                         remote=True))))
         _, remote_changed = self._fan_out_writes(
-            jobs, covered, count_shards=remote_only)
+            jobs, covered, count_shards=remote_only,
+            index_name=index_name)
         self._broadcast_shards_if_changed(index_name)
         return changed + remote_changed
 
@@ -600,7 +615,8 @@ class API:
                 remote=True))) for node in remotes]
         _, remote_changed = self._fan_out_writes(
             jobs, {shard} if local else set(),
-            count_shards=() if local else {shard})
+            count_shards=() if local else {shard},
+            index_name=index_name)
         self._broadcast_shards_if_changed(index_name)
         return changed if local else remote_changed
 

@@ -444,3 +444,52 @@ def test_group_by_offset(env):
     # offset < len(results))
     got = e.execute("i", "GroupBy(Rows(g), offset=10)")[0]
     assert got == all_groups
+
+
+# -------- argument validation parity (reference: executor_test.go
+# TestExecutor_Execute_Query_Error + Call.UintArg pql/ast.go:315,
+# TestExecutor_Execute_ErrMaxWritesPerRequest executor_test.go:2514)
+
+
+def test_negative_uint_args_rejected(env):
+    """Negative limit/offset/n/previous error like the reference instead
+    of silently serving an empty result."""
+    h, e = env
+    h.create_index("i").create_field("general")
+    cases = [
+        "Rows(general, limit=-1)",
+        "Rows(general, previous=-2)",
+        "Rows(general, column=-1)",
+        "TopN(general, n=-1)",
+        "TopN(general, threshold=-1)",
+        "GroupBy(Rows(general), limit=-1)",
+        "GroupBy(Rows(general), offset=-1)",
+        "GroupBy(Rows(general, limit=-1))",
+    ]
+    for q in cases:
+        with pytest.raises(Exception, match="must be positive, but got"):
+            e.execute("i", q)
+    # GroupBy(Rows()) still parses-or-errors, never silently succeeds
+    with pytest.raises(Exception):
+        e.execute("i", "GroupBy(Rows())")
+
+
+def test_max_writes_per_request(tmp_path):
+    """(reference: ErrTooManyWrites — 'too many write commands')"""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API, ApiError
+
+    holder = Holder(str(tmp_path / "mw")).open()
+    api = API(holder, max_writes_per_request=3)
+    api.create_index("i")
+    api.create_field("i", "f")
+    # 3 writes pass
+    assert api.query("i", "Set(1, f=1) Set(2, f=1) Clear(3, f=1)")
+    # 4 writes rejected, nothing about reads
+    with pytest.raises(ApiError, match="too many write commands"):
+        api.query("i", "Set(1, f=1) Clear(2, f=1) Set(3, f=1) Set(4, f=1)")
+    # reads don't count toward the limit
+    assert api.query(
+        "i", "Count(Row(f=1)) Count(Row(f=1)) Count(Row(f=1)) "
+             "Count(Row(f=1)) Set(9, f=1)")
+    holder.close()

@@ -80,7 +80,11 @@ def test_add_node_streams_fragments(rcluster):
     rows = rng.integers(0, 4, 500, dtype=np.uint64)
     a.api.import_bits("ri", "f", rows, cols)
     before = a.client.query("ri", "Count(Row(f=1))")["results"][0]
-    assert before > 0
+    # validate against host ground truth: an import must be COUNT-visible
+    # immediately (read-your-writes through the gossiped shard map — a
+    # lagging async push once made this silently drop a remote shard)
+    want_truth = len({int(c) for c, r in zip(cols, rows) if r == 1})
+    assert before == want_truth, (before, want_truth)
 
     job = a.client.resize_add_node(make_node(new).id, new.address)
     assert wait_until(lambda: a.client.resize_status()["job"] is not None
