@@ -70,6 +70,12 @@ def uint_arg(call, key):
     return val, True
 
 
+def uint_arg_or_none(call, key):
+    """Validated optional unsigned arg: the value, or None when absent."""
+    val, has = uint_arg(call, key)
+    return val if has else None
+
+
 def check_write_limit(query, max_writes):
     """(reference: executor.Execute executor.go:135 + ErrTooManyWrites)"""
     if max_writes and max_writes > 0:
@@ -689,13 +695,12 @@ class Executor:
             raise ExecError("TopN() can only have one input bitmap")
         if call.children:
             self.validate_bitmap_call(idx, call.children[0])
-        n_val, has_n = uint_arg(call, "n")
-        n = n_val if has_n else None
+        n = uint_arg_or_none(call, "n")
         ids = call.args.get("ids")
-        thr, has_thr = uint_arg(call, "threshold")
-        threshold = thr if has_thr else 1
+        thr = uint_arg_or_none(call, "threshold")
+        threshold = 1 if thr is None else thr
         tanimoto, _ = uint_arg(call, "tanimotoThreshold")
-        if tanimoto > 100 or tanimoto < 0:
+        if tanimoto > 100:  # negatives already rejected by uint_arg
             raise ExecError("Tanimoto Threshold is from 1 to 100 only")
         if tanimoto > 0 and not call.children:
             raise ExecError(
@@ -896,12 +901,9 @@ class Executor:
     def _exec_rows(self, idx, call, shards, opt):
         """(reference: executeRows executor.go:1280)"""
         field = self._set_field(idx, call)
-        limit_val, has_limit = uint_arg(call, "limit")
-        limit = limit_val if has_limit else None
-        prev_val, has_prev = uint_arg(call, "previous")
-        previous = prev_val if has_prev else None
-        col_val, has_col = uint_arg(call, "column")
-        column = col_val if has_col else None
+        limit = uint_arg_or_none(call, "limit")
+        previous = uint_arg_or_none(call, "previous")
+        column = uint_arg_or_none(call, "column")
 
         rows = set()
         shard_list = self._call_shards(idx, shards)
@@ -914,18 +916,18 @@ class Executor:
                 if frag is None:
                     continue
                 if column is not None:
-                    if int(column) // SHARD_WIDTH != shard:
+                    if column // SHARD_WIDTH != shard:
                         continue
                     for r in frag.row_ids():
-                        if frag.contains(r, int(column)):
+                        if frag.contains(r, column):
                             rows.add(r)
                 else:
                     rows.update(frag.row_ids())
         out = sorted(rows)
         if previous is not None:
-            out = [r for r in out if r > int(previous)]
+            out = [r for r in out if r > previous]
         if limit is not None and not opt.remote:
-            out = out[:int(limit)]
+            out = out[:limit]
         return RowIdentifiers(rows=out)
 
     # -------------------------------------------------------------- GroupBy
@@ -940,9 +942,8 @@ class Executor:
         for child in call.children:
             if child.name != "Rows":
                 raise ExecError("GroupBy children must be Rows() calls")
-        limit_val, has_limit = uint_arg(call, "limit")
-        limit = limit_val if has_limit else None
-        offset_val, has_offset = uint_arg(call, "offset")
+        limit = uint_arg_or_none(call, "limit")
+        offset = uint_arg_or_none(call, "offset")
         filter_call = call.args.get("filter")
         if filter_call is not None:
             if not isinstance(filter_call, Call):
@@ -972,12 +973,12 @@ class Executor:
             for group, cnt in sorted(totals.items())
         ]
         if limit is not None and not opt.remote:
-            out = out[:int(limit)]
+            out = out[:limit]
         # offset applies after the limit-bounded merge, and is a NO-OP
         # when it reaches past the result set (reference guards
         # `offset < len(results)`: executeGroupBy executor.go:1134-1143)
-        if has_offset and not opt.remote and offset_val < len(out):
-            out = out[offset_val:]
+        if offset is not None and not opt.remote and offset < len(out):
+            out = out[offset:]
         return out
 
     def _group_by_stacked(self, idx, fields, child_rows, filter_call,

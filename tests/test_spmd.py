@@ -300,6 +300,40 @@ def test_minmax_merges_via_collective(cluster):
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
 
 
+def test_all_aggregates_from_all_nodes(cluster):
+    """Every collective kind initiates from EVERY node: the forward hop
+    makes the data plane node-agnostic, like the reference's any-node
+    coordination (executor.Execute executor.go:113)."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "af")
+    coord.create_field("sp", "av", options={"type": "int",
+                                            "min": 0, "max": 100})
+    time.sleep(1.0)
+    cols = [s * SHARD_WIDTH + 6 for s in range(6)]
+    coord.import_bits("sp", "af", [4] * len(cols), cols)
+    coord.import_values("sp", "av", cols, [10 * (i + 1)
+                                           for i in range(len(cols))])
+    queries = [
+        ("Count(Row(af=4))", len(cols)),
+        ("Sum(field=av)", {"value": sum(10 * (i + 1)
+                                        for i in range(len(cols))),
+                           "count": len(cols)}),
+        ("Min(field=av)", {"value": 10, "count": 1}),
+        ("Max(field=av)", {"value": 60, "count": 1}),
+        ("TopN(af, n=1)", [{"id": 4, "count": len(cols)}]),
+        ("GroupBy(Rows(af))",
+         [{"group": [{"field": "af", "rowID": 4}], "count": len(cols)}]),
+    ]
+    before = _spmd_steps(cluster)
+    for i, (pql, want) in enumerate(queries):
+        node = cluster.clients[i % 3]  # rotate initiating node
+        got = node.query("sp", pql)["results"][0]
+        assert got == want, (pql, got, want)
+    after = _spmd_steps(cluster)
+    assert all(a - b == len(queries)
+               for a, b in zip(after, before)), (before, after)
+
+
 def test_bsi_condition_count_via_collective(cluster):
     """Count(Row(v > t)) is SPMD-eligible: condition leaves ride the same
     shared signature walk; each process contributes locally-evaluated
