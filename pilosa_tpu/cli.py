@@ -370,25 +370,60 @@ def cmd_import(args):
         except Exception:
             pass
 
-    rows, cols, values = [], [], []
+    # keyed imports: detect row/column keys from the live schema like the
+    # reference (ctl/import.go useRowKeys/useColumnKeys from field/index
+    # options); unknown index/field falls back to numeric ids
+    use_row_keys = use_col_keys = False
+    try:
+        schema = client.schema()
+        for idx_desc in schema.get("indexes", []):
+            if idx_desc["name"] != args.index:
+                continue
+            use_col_keys = bool(
+                idx_desc.get("options", {}).get("keys", False))
+            for f_desc in idx_desc.get("fields", []):
+                if f_desc["name"] == args.field:
+                    use_row_keys = bool(
+                        f_desc.get("options", {}).get("keys", False))
+    except Exception:
+        pass
+
+    rows, cols, values, stamps = [], [], [], []
     total = 0
     source = open(args.file) if args.file != "-" else sys.stdin
     try:
         reader = csv_mod.reader(source)
-        for record in reader:
+        for rnum, record in enumerate(reader, 1):
             if not record:
                 continue
-            if args.field_type == "int":
-                cols.append(int(record[0]))
-                values.append(int(record[1]))
-            else:
-                rows.append(int(record[0]))
-                cols.append(int(record[1]))
+            try:
+                if args.field_type == "int":
+                    cols.append(record[0] if use_col_keys
+                                else int(record[0]))
+                    values.append(int(record[1]))
+                else:
+                    rows.append(record[0] if use_row_keys
+                                else int(record[0]))
+                    cols.append(record[1] if use_col_keys
+                                else int(record[1]))
+                    # optional 3rd column: timestamp — TIME fields only
+                    # (reference format "2006-01-02T15:04",
+                    # ctl/import.go:234); other field types ignore extra
+                    # columns, as the pre-timestamp CLI did
+                    stamps.append(
+                        record[2] if args.field_type == "time"
+                        and len(record) > 2 and record[2] else None)
+            except (ValueError, IndexError) as e:
+                raise SystemExit(
+                    f"import: invalid record on line {rnum}: "
+                    f"{record!r} ({e})")
             if len(cols) >= args.batch_size:
-                total += _flush_import(client, args, rows, cols, values)
-                rows, cols, values = [], [], []
+                total += _flush_import(client, args, rows, cols, values,
+                                       stamps, use_row_keys, use_col_keys)
+                rows, cols, values, stamps = [], [], [], []
         if cols:
-            total += _flush_import(client, args, rows, cols, values)
+            total += _flush_import(client, args, rows, cols, values,
+                                   stamps, use_row_keys, use_col_keys)
     finally:
         if source is not sys.stdin:
             source.close()
@@ -396,11 +431,21 @@ def cmd_import(args):
     return 0
 
 
-def _flush_import(client, args, rows, cols, values):
+def _flush_import(client, args, rows, cols, values, stamps,
+                  use_row_keys, use_col_keys):
+    col_kw = {"column_keys": cols} if use_col_keys else {}
     if args.field_type == "int":
-        out = client.import_values(args.index, args.field, cols, values)
+        out = client.import_values(
+            args.index, args.field, [] if use_col_keys else cols, values,
+            **col_kw)
     else:
-        out = client.import_bits(args.index, args.field, rows, cols)
+        row_kw = {"row_keys": rows} if use_row_keys else {}
+        timestamps = stamps if any(s is not None for s in stamps) else None
+        out = client.import_bits(
+            args.index, args.field,
+            [] if use_row_keys else rows,
+            [] if use_col_keys else cols,
+            timestamps=timestamps, **row_kw, **col_kw)
     return out.get("changed", 0) if isinstance(out, dict) else 0
 
 

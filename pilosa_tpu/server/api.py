@@ -499,12 +499,47 @@ class API:
                     remote_changed[shard], resp.get("changed", 0))
         return results, sum(remote_changed.values())
 
+    def _translate_import_keys(self, index_name, field_name,
+                               row_keys, column_keys):
+        """String keys -> IDs for bulk imports on the COORDINATING node
+        (reference: api.Import key translation api.go:920-1000; remote
+        forwards always carry integer IDs). Returns (row_ids, column_ids)
+        for whichever key lists were given."""
+        idx = self.holder.index(index_name)
+        field = idx.field(field_name)
+        # validate BOTH options before translating EITHER list: key
+        # translation allocates ids permanently (and replicates them), so
+        # a rejected import must not leave freshly-minted keys behind
+        if column_keys is not None and not idx.options.keys:
+            raise ApiError(f"index {index_name} does not use column keys")
+        if row_keys is not None and not field.options.keys:
+            raise ApiError(f"field {field_name} does not use row keys")
+        row_ids = column_ids = None
+        # batch API: on a replica (read-only store) per-key translation
+        # would cost one primary-forward roundtrip per key
+        if column_keys is not None:
+            column_ids = list(
+                idx.translate_store.translate_keys(column_keys))
+        if row_keys is not None:
+            row_ids = list(
+                field.translate_store.translate_keys(row_keys))
+        return row_ids, column_ids
+
     def import_bits(self, index_name, field_name, row_ids, column_ids,
-                    timestamps=None, clear=False, remote=False):
+                    timestamps=None, clear=False, remote=False,
+                    row_keys=None, column_keys=None):
         """(reference: api.Import api.go:920 — sort bits by shard, forward
-        each slice to all replica owners concurrently)"""
+        each slice to all replica owners concurrently; string keys are
+        translated here, on the coordinating node)"""
         self._validate_state()
         field = self._field(index_name, field_name)
+        if row_keys is not None or column_keys is not None:
+            t_rows, t_cols = self._translate_import_keys(
+                index_name, field_name, row_keys, column_keys)
+            if t_rows is not None:
+                row_ids = t_rows
+            if t_cols is not None:
+                column_ids = t_cols
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
             changed = field.import_bits(
                 row_ids, column_ids, timestamps=timestamps, clear=clear)
@@ -556,9 +591,12 @@ class API:
         return changed + remote_changed
 
     def import_values(self, index_name, field_name, column_ids, values,
-                      remote=False):
+                      remote=False, column_keys=None):
         self._validate_state()
         field = self._field(index_name, field_name)
+        if column_keys is not None:
+            _, column_ids = self._translate_import_keys(
+                index_name, field_name, None, column_keys)
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
             changed = field.import_values(column_ids, values)
             self.holder.index(index_name).add_existence(column_ids)

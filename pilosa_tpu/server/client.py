@@ -112,7 +112,8 @@ class Client:
     # -- imports -------------------------------------------------------------
 
     def import_bits(self, index, field, row_ids, column_ids,
-                    timestamps=None, clear=False, remote=False):
+                    timestamps=None, clear=False, remote=False,
+                    row_keys=None, column_keys=None):
         path = f"/index/{index}/field/{field}/import"
         params = []
         if clear:
@@ -121,18 +122,29 @@ class Client:
             params.append("remote=true")
         if params:
             path += "?" + "&".join(params)
-        body = {"rowIDs": [int(r) for r in row_ids],
-                "columnIDs": [int(c) for c in column_ids]}
+        body = {}
+        if row_keys is not None:
+            body["rowKeys"] = list(row_keys)
+        else:
+            body["rowIDs"] = [int(r) for r in row_ids]
+        if column_keys is not None:
+            body["columnKeys"] = list(column_keys)
+        else:
+            body["columnIDs"] = [int(c) for c in column_ids]
         if timestamps is not None:
             body["timestamps"] = timestamps
         return self._request("POST", path, json.dumps(body).encode())
 
-    def import_values(self, index, field, column_ids, values, remote=False):
+    def import_values(self, index, field, column_ids, values, remote=False,
+                      column_keys=None):
         path = f"/index/{index}/field/{field}/import"
         if remote:
             path += "?remote=true"
-        body = {"columnIDs": [int(c) for c in column_ids],
-                "values": [int(v) for v in values]}
+        body = {"values": [int(v) for v in values]}
+        if column_keys is not None:
+            body["columnKeys"] = list(column_keys)
+        else:
+            body["columnIDs"] = [int(c) for c in column_ids]
         return self._request("POST", path, json.dumps(body).encode())
 
     def import_roaring(self, index, field, shard, data, clear=False,

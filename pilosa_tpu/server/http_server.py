@@ -211,7 +211,7 @@ class PilosaHTTPServer:
         if "values" in body:
             changed = self.api.import_values(
                 index, field, body.get("columnIDs", []), body["values"],
-                remote=remote)
+                remote=remote, column_keys=body.get("columnKeys"))
         else:
             timestamps = body.get("timestamps")
             if timestamps is not None:
@@ -220,7 +220,9 @@ class PilosaHTTPServer:
             changed = self.api.import_bits(
                 index, field, body.get("rowIDs", []),
                 body.get("columnIDs", []), timestamps=timestamps,
-                clear=clear, remote=remote)
+                clear=clear, remote=remote,
+                row_keys=body.get("rowKeys"),
+                column_keys=body.get("columnKeys"))
         return {"changed": changed}
 
     def _post_import_roaring(self, req):

@@ -204,3 +204,85 @@ def test_post_schema_applies(srv):
     schema = c.schema()
     idx = next(i for i in schema["indexes"] if i["name"] == "x")
     assert idx["fields"][0]["options"]["timeQuantum"] == "YMD"
+
+
+def test_keyed_bulk_import(tmp_path):
+    """Keyed bulk imports translate on the coordinating node (reference:
+    api.Import key translation api.go:920; handler accepts
+    rowKeys/columnKeys)."""
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path / "ki"))
+    try:
+        h.client.create_index("ki", keys=True)
+        h.client.create_field("ki", "f", options={"keys": True})
+        h.client.import_bits(
+            "ki", "f", [], [],
+            row_keys=["red", "red", "blue"],
+            column_keys=["c1", "c2", "c3"])
+        got = h.client.query("ki", 'Row(f="red")')["results"][0]
+        assert sorted(got["keys"]) == ["c1", "c2"]
+        got = h.client.query("ki", 'Count(Row(f="blue"))')["results"][0]
+        assert got == 1
+
+        # keyed value import on a keyed index
+        h.client.create_field("ki", "v", options={"type": "int",
+                                                  "min": 0, "max": 100})
+        h.client.import_values("ki", "v", [], [7, 9],
+                               column_keys=["c1", "c2"])
+        got = h.client.query("ki", "Sum(field=v)")["results"][0]
+        assert got == {"value": 16, "count": 2}
+
+        # keys on a keyless field error cleanly
+        h.client.create_field("ki", "plain")
+        try:
+            h.client.import_bits("ki", "plain", [], [],
+                                 row_keys=["x"], column_keys=["c1"])
+            raise AssertionError("expected key-translation error")
+        except Exception as e:
+            assert "does not use row keys" in str(e)
+    finally:
+        h.close()
+
+
+def test_csv_import_cli_timestamps_and_keys(tmp_path):
+    """CSV import CLI parity: optional 3rd timestamp column for time
+    fields (reference format 2006-01-02T15:04, ctl/import.go:234) and
+    schema-driven key detection (useRowKeys/useColumnKeys)."""
+    from pilosa_tpu.cli import main
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path / "csv"))
+    try:
+        h.client.create_index("ci")
+        h.client.create_field("ci", "t", options={"type": "time",
+                                                  "timeQuantum": "YMD"})
+        csv_path = str(tmp_path / "bits.csv")
+        with open(csv_path, "w") as f:
+            f.write("1,10,2019-01-02T03:04\n"
+                    "1,11,2019-06-07T08:09\n"
+                    "2,10,\n")
+        rc = main(["import", "--host", h.address, "--index", "ci",
+                   "--field", "t", "--field-type", "time", csv_path])
+        assert rc == 0
+        got = h.client.query("ci", "Count(Row(t=1))")["results"][0]
+        assert got == 2
+        # time-range query sees only the January bit
+        got = h.client.query(
+            "ci",
+            "Row(t=1, from=2019-01-01T00:00, to=2019-02-01T00:00)")
+        assert got["results"][0]["columns"] == [10]
+
+        # keyed CSV: schema-driven detection, no extra flags
+        h.client.create_index("ck", keys=True)
+        h.client.create_field("ck", "kf", options={"keys": True})
+        keyed_path = str(tmp_path / "keyed.csv")
+        with open(keyed_path, "w") as f:
+            f.write("red,c1\nred,c2\nblue,c3\n")
+        rc = main(["import", "--host", h.address, "--index", "ck",
+                   "--field", "kf", keyed_path])
+        assert rc == 0
+        got = h.client.query("ck", 'Count(Row(kf="red"))')["results"][0]
+        assert got == 2
+    finally:
+        h.close()
