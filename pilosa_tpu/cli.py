@@ -372,21 +372,22 @@ def cmd_import(args):
 
     # keyed imports: detect row/column keys from the live schema like the
     # reference (ctl/import.go useRowKeys/useColumnKeys from field/index
-    # options); unknown index/field falls back to numeric ids
+    # options). A FAILED schema fetch aborts loudly — guessing "unkeyed"
+    # could import numeric-looking keys as raw ids onto wrong columns.
     use_row_keys = use_col_keys = False
     try:
         schema = client.schema()
-        for idx_desc in schema.get("indexes", []):
-            if idx_desc["name"] != args.index:
-                continue
-            use_col_keys = bool(
-                idx_desc.get("options", {}).get("keys", False))
-            for f_desc in idx_desc.get("fields", []):
-                if f_desc["name"] == args.field:
-                    use_row_keys = bool(
-                        f_desc.get("options", {}).get("keys", False))
-    except Exception:
-        pass
+    except Exception as e:
+        raise SystemExit(f"import: cannot fetch schema from {args.host}: {e}")
+    for idx_desc in schema.get("indexes", []):
+        if idx_desc["name"] != args.index:
+            continue
+        use_col_keys = bool(
+            idx_desc.get("options", {}).get("keys", False))
+        for f_desc in idx_desc.get("fields", []):
+            if f_desc["name"] == args.field:
+                use_row_keys = bool(
+                    f_desc.get("options", {}).get("keys", False))
 
     rows, cols, values, stamps = [], [], [], []
     total = 0
@@ -433,19 +434,18 @@ def cmd_import(args):
 
 def _flush_import(client, args, rows, cols, values, stamps,
                   use_row_keys, use_col_keys):
-    col_kw = {"column_keys": cols} if use_col_keys else {}
+    # Client treats None key lists as absent, so the keys-vs-ids split is
+    # one conditional per axis
+    column_keys = cols if use_col_keys else None
     if args.field_type == "int":
-        out = client.import_values(
-            args.index, args.field, [] if use_col_keys else cols, values,
-            **col_kw)
+        out = client.import_values(args.index, args.field, cols, values,
+                                   column_keys=column_keys)
     else:
-        row_kw = {"row_keys": rows} if use_row_keys else {}
         timestamps = stamps if any(s is not None for s in stamps) else None
         out = client.import_bits(
-            args.index, args.field,
-            [] if use_row_keys else rows,
-            [] if use_col_keys else cols,
-            timestamps=timestamps, **row_kw, **col_kw)
+            args.index, args.field, rows, cols, timestamps=timestamps,
+            row_keys=rows if use_row_keys else None,
+            column_keys=column_keys)
     return out.get("changed", 0) if isinstance(out, dict) else 0
 
 

@@ -671,16 +671,49 @@ class API:
 
     def export_csv(self, index_name, field_name, shard):
         """(reference: api.ExportCSV api.go:500) row,col lines for one
-        shard."""
+        shard, translating ids back to keys on keyed fields/indexes
+        (api.go:538-557) so an export re-imports losslessly."""
+        idx = self.holder.index(index_name)
         field = self._field(index_name, field_name)
         view = field.view()
         frag = view.fragment(int(shard)) if view else None
         buf = io.StringIO()
         writer = csv.writer(buf)
-        if frag is not None:
-            for row_id in frag.row_ids():
-                for col in frag.row_columns(row_id):
-                    writer.writerow([row_id, int(col)])
+        if frag is None:
+            return buf.getvalue()
+
+        def _batch_translate(store, ids, what):
+            """Batched id->key with loud failure: a silently empty CSV
+            cell would break the lossless export->import round trip
+            (e.g. a replica whose translate sync hasn't caught up)."""
+            out = {}
+            for id_, key in zip(ids, store.translate_ids(ids)):
+                if key is None:
+                    raise ApiError(
+                        f"translating {what} id {id_} failed: key not "
+                        "found (translate replication may be catching "
+                        "up; retry or export from the primary)")
+                out[id_] = key
+            return out
+
+        row_ids = frag.row_ids()
+        row_out = {r: r for r in row_ids}
+        if field.options.keys:
+            row_out = _batch_translate(
+                field.translate_store, row_ids, "row")
+        col_memo = {}
+        for row_id in row_ids:
+            cols = [int(c) for c in frag.row_columns(row_id)]
+            if idx.options.keys:
+                missing = [c for c in cols if c not in col_memo]
+                if missing:
+                    col_memo.update(_batch_translate(
+                        idx.translate_store, missing, "column"))
+                for col in cols:
+                    writer.writerow([row_out[row_id], col_memo[col]])
+            else:
+                for col in cols:
+                    writer.writerow([row_out[row_id], col])
         return buf.getvalue()
 
     # -- info/status --------------------------------------------------------

@@ -493,3 +493,40 @@ def test_max_writes_per_request(tmp_path):
         "i", "Count(Row(f=1)) Count(Row(f=1)) Count(Row(f=1)) "
              "Count(Row(f=1)) Set(9, f=1)")
     holder.close()
+
+
+def test_time_clear_across_quantum_views(tmp_path):
+    """Clear() removes a column from EVERY quantum view, so time-range
+    reads never resurrect cleared bits (golden behavior from reference
+    executor_test.go:2579 TestExecutor_Time_Clear_Quantums, all quantum
+    configurations)."""
+    from pilosa_tpu.core.field import FieldOptions
+
+    cases = {
+        "Y": [3, 4, 5, 6], "M": [3, 4, 5, 6], "D": [3, 4, 5, 6],
+        "H": [3, 4, 5, 6, 7], "YM": [3, 4, 5, 6], "YMD": [3, 4, 5, 6],
+        "YMDH": [3, 4, 5, 6, 7], "MD": [3, 4, 5, 6],
+        "MDH": [3, 4, 5, 6, 7], "DH": [3, 4, 5, 6, 7],
+    }
+    populate = [
+        "Set(2, f=1, 1999-12-31T00:00)",
+        "Set(3, f=1, 2000-01-01T00:00)",
+        "Set(4, f=1, 2000-01-02T00:00)",
+        "Set(5, f=1, 2000-02-01T00:00)",
+        "Set(6, f=1, 2001-01-01T00:00)",
+        "Set(7, f=1, 2002-01-01T02:00)",
+        "Set(2, f=1, 1999-12-30T00:00)",
+        "Set(2, f=1, 2002-02-01T00:00)",
+        "Set(2, f=10, 2001-01-01T00:00)",
+    ]
+    check = "Row(f=1, from=1999-12-31T00:00, to=2002-01-01T03:00)"
+    for i, (quantum, expected) in enumerate(cases.items()):
+        h = Holder(str(tmp_path / f"q{i}"), use_snapshot_queue=False).open()
+        idx = h.create_index("i")
+        idx.create_field("f", FieldOptions.time_field(quantum))
+        e = Executor(h)
+        e.execute("i", " ".join(populate))
+        e.execute("i", "Clear(2, f=1)")
+        got = cols(e.execute("i", check)[0])
+        assert got == expected, (quantum, got, expected)
+        h.close()

@@ -286,3 +286,22 @@ def test_csv_import_cli_timestamps_and_keys(tmp_path):
         assert got == 2
     finally:
         h.close()
+
+
+def test_export_csv_translates_keys(tmp_path):
+    """Export emits keys on keyed fields/indexes (reference:
+    ExportCSV api.go:538-557) so export -> import round-trips."""
+    from tests.harness import ServerHarness
+
+    h = ServerHarness(data_dir=str(tmp_path / "xk"))
+    try:
+        h.client.create_index("xk", keys=True)
+        h.client.create_field("xk", "f", options={"keys": True})
+        h.client.import_bits("xk", "f", [], [],
+                             row_keys=["red", "blue"],
+                             column_keys=["c1", "c2"])
+        out = h.client.export_csv("xk", "f", 0)
+        lines = sorted(line for line in out.strip().splitlines())
+        assert lines == ["blue,c2", "red,c1"]
+    finally:
+        h.close()
