@@ -267,22 +267,49 @@ def main():
     }))
 
 
+def _watchdog(seconds: float = 540.0):
+    """Hard deadline for the whole bench: the remote-device tunnel can
+    hang so completely that even backend init blocks forever (observed;
+    see .claude/skills/verify/SKILL.md gotchas), which no in-thread retry
+    can catch. Emit the one JSON line and hard-exit so the driver's
+    BENCH_r{N}.json never comes up empty."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "error", "value": 0, "unit": "",
+            "vs_baseline": 0,
+            "error": f"bench watchdog: no result within {seconds:.0f}s "
+                     "(device tunnel hung?)",
+        }), flush=True)
+        os._exit(1)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main_with_retry(attempts: int = 3) -> None:
     """Run main(), retrying transient failures (flaky backend init, device
     grab races). Always emits exactly one JSON line: on total failure, an
     error record instead of silence, so the driver's BENCH_r{N}.json never
     comes up empty."""
+    timer = _watchdog()
     last = None
     for attempt in range(attempts):
         try:
             main()
-            return
+            timer.cancel()  # success emitted: the deadline must not
+            return          # fire a second JSON record afterwards
         except SystemExit:
             raise
         except Exception as exc:  # noqa: BLE001 — last-resort bench guard
             last = exc
             traceback.print_exc(file=sys.stderr)
             time.sleep(2.0 * (attempt + 1))
+    timer.cancel()
     print(json.dumps({
         "metric": "error", "value": 0, "unit": "",
         "vs_baseline": 0,
