@@ -697,6 +697,12 @@ class Executor:
             self.validate_bitmap_call(idx, call.children[0])
         n = uint_arg_or_none(call, "n")
         ids = call.args.get("ids")
+        if ids is not None and (
+                not isinstance(ids, list)
+                or any(isinstance(r, bool) or not isinstance(r, int)
+                       for r in ids)):
+            # (reference: validateCallArgs executor.go:342-358)
+            raise ExecError(f"invalid call.Args[ids]: {ids!r}")
         thr = uint_arg_or_none(call, "threshold")
         threshold = 1 if thr is None else thr
         tanimoto, _ = uint_arg(call, "tanimotoThreshold")

@@ -354,6 +354,19 @@ def test_bsi_condition_count_via_collective(cluster):
     after = _spmd_steps(cluster)
     assert all(a - b == 2 for a, b in zip(after, before)), (before, after)
 
+    # condition leaves also work as aggregate FILTERS over the collective
+    coord.create_field("sp", "cw", options={"type": "int",
+                                            "min": 0, "max": 50})
+    time.sleep(1.0)
+    coord.import_values("sp", "cw", cols, [i + 1 for i in range(len(cols))])
+    before = after
+    got = coord.query("sp", "Sum(Row(cv > 0), field=cw)")["results"][0]
+    want = sum(i + 1 for i, v in enumerate(vals) if v > 0)
+    assert got == {"value": want,
+                   "count": sum(1 for v in vals if v > 0)}
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
 
 def test_groupby_merges_via_collective(cluster):
     """GroupBy rides the SPMD data plane: per-child candidate rows union
