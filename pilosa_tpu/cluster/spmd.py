@@ -202,8 +202,11 @@ class SpmdDataPlane:
                 leaves[key] = len(leaves)
             return ("leaf", leaves[key])
 
+        from ..exec.stacked import intern_time_leaf
+
         leaves = {}
-        sig = tree_signature(idx, call, leaves, leaf, bsi_leaf)
+        sig = tree_signature(idx, call, leaves, leaf, bsi_leaf,
+                             intern_time_leaf)
         if sig is None or not leaves:
             return None
         ordered = sorted(leaves.items(), key=lambda kv: kv[1])
@@ -217,6 +220,9 @@ class SpmdDataPlane:
             _, field_name, op, vals = key
             return ["bsicond", field_name, op,
                     list(vals) if isinstance(vals, tuple) else vals]
+        if key[0] == "timerow":
+            _, field_name, row_id, views = key
+            return ["timerow", field_name, row_id, list(views)]
         _, field_name, row_id = key
         return ["row", field_name, row_id]
 
@@ -941,6 +947,16 @@ class SpmdDataPlane:
                 _, field_name, op, vals = entry
                 local = self._local_cond_block(
                     idx, step, field_name, op, vals)
+            elif entry[0] == "timerow":
+                # union across the quantum-view cover, host-side (each
+                # view's block is defensive zeros when absent locally)
+                _, field_name, row_id, views = entry
+                local = np.zeros((int(step["seg_len"]), WORDS_PER_ROW),
+                                 dtype=np.uint32)
+                for view_name in views:
+                    local |= self._local_block(
+                        idx, step, field_name, int(row_id),
+                        view_name=view_name)
             else:
                 _, field_name, row_id = entry
                 local = self._local_block(idx, step, field_name,

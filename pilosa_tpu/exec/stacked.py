@@ -161,6 +161,9 @@ MIN_SHARDS = 2
 # large, so TopN/GroupBy dispatch count is O(rows/chunk) — independent of
 # the shard count.
 CHUNK_BYTES = 128 * 1024 * 1024
+# Time-range leaves union one cached stack per quantum view in the range
+# cover; wider covers (a years-long hourly span) use the per-shard path.
+MAX_TIME_VIEWS = 64
 
 _OPS = {"Intersect": "&", "Union": "|", "Difference": "-", "Xor": "^"}
 
@@ -170,20 +173,75 @@ from ..ops import bitplane  # noqa: E402
 from ..ops.bitplane import combine_hi_lo  # noqa: E402  (canonical helper)
 
 
-def tree_signature(idx, call, leaves, leaf, bsi_leaf=None):
+def time_range_views(idx, field_name, args):
+    """Quantum-view name cover for a time-range Row, or None when the
+    field isn't a time field / has no quantum. Pure function of the
+    REPLICATED schema + call args (both stacked and SPMD leaves use it;
+    semantics identical to the executor's per-shard _row_shard)."""
+    from ..core import timeq
+    from ..core.field import FIELD_TYPE_TIME
+
+    field = idx.field(field_name)
+    if field is None or field.type != FIELD_TYPE_TIME:
+        return None
+    quantum = field.time_quantum()
+    if not quantum:
+        return None
+    try:
+        from_t = timeq.parse_time(args["from"]) if "from" in args \
+            else timeq.parse_time("1970-01-01T00:00")
+        to_t = timeq.parse_time(args["to"]) if "to" in args \
+            else timeq.parse_time("2100-01-01T00:00")
+    except Exception:
+        return None  # malformed timestamps: per-shard path raises cleanly
+    views = tuple(timeq.views_by_time_range(
+        VIEW_STANDARD, from_t, to_t, quantum))
+    if len(views) > MAX_TIME_VIEWS:
+        return None  # a huge hourly span: per-shard path handles it
+    return views
+
+
+def intern_time_leaf(idx, field_name, row_id, args, leaves):
+    '''THE ("timerow", field, row, views) leaf interner, shared by the
+    stacked and SPMD signature walks so the leaf key shape lives in one
+    place (both sides consult only replicated schema).'''
+    views = time_range_views(idx, field_name, args)
+    if views is None:
+        return None
+    key = ("timerow", field_name, int(row_id), views)
+    if key not in leaves:
+        leaves[key] = len(leaves)
+    return ("leaf", leaves[key])
+
+
+def tree_signature(idx, call, leaves, leaf, bsi_leaf=None, time_leaf=None):
     """THE coverage walk for stacked/SPMD fast paths: turns a bitmap call
     tree into an operator signature over leaf slots, or None when any
-    shape isn't expressible (time ranges, Shift, keys, ...).
+    shape isn't expressible (Shift, keys, ...).
     `leaf(idx, field_name, row_id, leaves)` decides row-leaf eligibility —
     the stacked evaluator requires a local standard view; the SPMD plane
     checks replicated schema only (cluster/spmd.py).
     `bsi_leaf(idx, field_name, cond, leaves)` (optional) covers BSI
     condition leaves like Row(v > 10) the same way (reference algorithm:
-    fragment.go:1357-1470); None declines conditions entirely."""
+    fragment.go:1357-1470); None declines conditions entirely.
+    `time_leaf(idx, field_name, row_id, args, leaves)` (optional) covers
+    time-range rows Row(t=1, from=..., to=...) as a union over the
+    quantum-view cover (reference: viewsByTimeRange time.go:91); None
+    declines time ranges entirely."""
     name = call.name
     if name in ("Row", "Range"):
         if "from" in call.args or "to" in call.args:
-            return None
+            if time_leaf is None or call.has_conditions():
+                return None
+            field_name = call.field_arg()
+            if field_name is None:
+                return None
+            row_id = call.args.get(field_name)
+            if isinstance(row_id, bool):
+                row_id = int(row_id)
+            if not isinstance(row_id, int):
+                return None
+            return time_leaf(idx, field_name, row_id, call.args, leaves)
         if call.has_conditions():
             if bsi_leaf is None or len(call.args) != 1:
                 return None
@@ -203,8 +261,9 @@ def tree_signature(idx, call, leaves, leaf, bsi_leaf=None):
             return None
         return leaf(idx, field_name, row_id, leaves)
     if name in _OPS and call.children:
-        subs = tuple(tree_signature(idx, c, leaves, leaf, bsi_leaf)
-                     for c in call.children)
+        subs = tuple(
+            tree_signature(idx, c, leaves, leaf, bsi_leaf, time_leaf)
+            for c in call.children)
         if any(s is None for s in subs):
             return None
         return (_OPS[name], subs)
@@ -212,7 +271,7 @@ def tree_signature(idx, call, leaves, leaf, bsi_leaf=None):
             and idx.options.track_existence \
             and idx.field(EXISTENCE_FIELD_NAME) is not None:
         child = tree_signature(idx, call.children[0], leaves, leaf,
-                               bsi_leaf)
+                               bsi_leaf, time_leaf)
         if child is None:
             return None
         exists = leaf(idx, EXISTENCE_FIELD_NAME, 0, leaves)
@@ -327,9 +386,10 @@ class StackedEvaluator:
 
     def signature(self, idx, call, leaves):
         """Tree signature with leaf slots, or None when the tree has any
-        shape the fast path doesn't cover (time ranges, Shift, keys...).
-        None means: use the general per-shard path."""
-        return tree_signature(idx, call, leaves, self._leaf, self._bsi_leaf)
+        shape the fast path doesn't cover (Shift, keys...). None means:
+        use the general per-shard path."""
+        return tree_signature(idx, call, leaves, self._leaf, self._bsi_leaf,
+                              intern_time_leaf)
 
     # -- stack cache ---------------------------------------------------------
 
@@ -639,6 +699,36 @@ class StackedEvaluator:
         self.dispatches += 1
         return apply_bsi_condition(plan, planes, sign, exists)
 
+    def time_row_stack(self, idx, key, shards):
+        """[S, W] union of one row across the quantum-view cover (the
+        time-range leaf). Each per-view stack is cached + incrementally
+        patched like any other; views absent on this holder contribute
+        nothing (exactly the executor's per-shard union semantics)."""
+        import jax.numpy as jnp
+
+        _, field_name, row_id, views = key
+        field = idx.field(field_name)
+        if field is None:
+            return None
+        stacks = []
+        for view_name in views:
+            if field.view(view_name) is None:
+                continue  # no data in this quantum bucket anywhere local
+            stack = self.rows_stack(idx, field_name, (row_id,),
+                                    tuple(shards), view_name=view_name)
+            if stack is None:
+                continue  # view vanished mid-query: zero contribution
+            stacks.append(stack[0])
+        if not stacks:
+            return jnp.zeros((self._padded_len(tuple(shards)),
+                              WORDS_PER_ROW), dtype=jnp.uint32)
+        if len(stacks) == 1:
+            return stacks[0]
+        # the evaluator's own union fold: one fn-cache, one operator impl
+        sig = ("|", tuple(("leaf", i) for i in range(len(stacks))))
+        self.dispatches += 1
+        return self._plane_fn(sig, len(stacks))(*stacks)
+
     def row_chunk_size(self, shards):
         """Rows per [R, S, W] chunk under the CHUNK_BYTES budget."""
         return max(
@@ -903,6 +993,8 @@ class StackedEvaluator:
         for key, _ in ordered:
             if key[0] == "bsicond":
                 stacks.append(self.bsi_condition_stack(idx, key, shards))
+            elif key[0] == "timerow":
+                stacks.append(self.time_row_stack(idx, key, shards))
             else:
                 _, field_name, row_id = key
                 stacks.append(

@@ -409,3 +409,26 @@ def test_groupby_merges_via_collective(cluster):
     assert got == expected[:2]
     after = _spmd_steps(cluster)
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+
+def test_time_range_count_via_collective(cluster):
+    """Time-range Row trees ride the collective: the quantum-view cover
+    derives from replicated schema, each process contributes the union of
+    its local view blocks."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "tt", options={"type": "time",
+                                            "timeQuantum": "YMD"})
+    time.sleep(1.0)
+    cols = [s * SHARD_WIDTH + 13 for s in range(6)]
+    coord.import_bits("sp", "tt", [1] * len(cols), cols,
+                      timestamps=["2019-01-02T03:04"] * 3
+                      + ["2020-06-07T08:09"] * 3)
+
+    before = _spmd_steps(cluster)
+    got = coord.query(
+        "sp",
+        "Count(Row(tt=1, from=2019-01-01T00:00, to=2019-02-01T00:00))"
+    )["results"][0]
+    assert got == 3
+    after = _spmd_steps(cluster)
+    assert all(a - b == 1 for a, b in zip(after, before)), (before, after)

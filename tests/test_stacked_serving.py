@@ -319,6 +319,58 @@ def test_bsi_condition_filtered_aggregates_stacked(tmp_path):
     holder.close()
 
 
+def test_time_range_count_stacked(tmp_path):
+    """Time-range Row trees are stacked-coverable: Count(Row(t=1,
+    from=..., to=...)) unions the quantum-view cover's cached stacks in
+    O(1)-in-shards dispatches and matches the per-shard path exactly."""
+    holder = Holder(str(tmp_path / "trc")).open()
+    api = API(holder)
+    api.create_index("i")
+    api.create_field("i", "t", FieldOptions.time_field("YMD"))
+    api.create_field("i", "flt")
+    n_shards = 4
+    stamps = ["2019-01-02T03:04", "2019-01-05T00:00", "2019-02-01T00:00",
+              "2020-06-07T08:09"]
+    cols, wire_stamps = [], []
+    for s in range(n_shards):
+        for k, st in enumerate(stamps):
+            cols.append(s * SHARD_WIDTH + 10 + k)
+            wire_stamps.append(st)
+    from pilosa_tpu.core.timeq import parse_time
+
+    api.import_bits("i", "t", [1] * len(cols), cols,
+                    timestamps=[parse_time(w) for w in wire_stamps])
+    api.import_bits("i", "flt", [7] * (2 * n_shards), cols[::2])
+    e = Executor(holder)
+
+    q = "Count(Row(t=1, from=2019-01-01T00:00, to=2019-03-01T00:00))"
+    want = 3 * n_shards  # Jan x2 + Feb, every shard
+    assert e.execute("i", q)[0] == want
+    # dispatch-invariance: warm, then count stays O(1)-in-shards
+    e.execute("i", q)
+    d0 = e._stacked.dispatches
+    assert e.execute("i", q)[0] == want
+    per_query = e._stacked.dispatches - d0
+    assert 0 < per_query <= 3, per_query
+
+    # composes with other leaves
+    q2 = ("Count(Intersect(Row(flt=7), "
+          "Row(t=1, from=2019-01-01T00:00, to=2019-03-01T00:00)))")
+    host = {c for c, st in zip(cols, wire_stamps)
+            if st.startswith("2019-0")} & set(cols[::2])
+    assert e.execute("i", q2)[0] == len(host)
+
+    # per-shard fallback agrees shard by shard
+    per_shard = sum(e.execute("i", q, shards=[s])[0]
+                    for s in range(n_shards))
+    assert per_shard == want
+
+    # a write into one quantum view is count-visible immediately
+    api.query("i", f"Set({2 * SHARD_WIDTH + 99}, t=1, 2019-01-09T00:00)")
+    assert e.execute("i", q)[0] == want + 1
+    holder.close()
+
+
 def test_count_patch_on_single_shard_write(tmp_path):
     """A write to ONE of many shards must NOT re-upload the whole serving
     stack: the next Count patches only the drifted shard's plane on device
