@@ -216,6 +216,16 @@ def _topn_call(n_rows, interpret):
 # the MSB-first comparator over the (static) depth with the predicate bits
 # read from SMEM, applies the sign-magnitude combine for the (static)
 # operator, and writes only the final row mask.
+#
+# PERF STATUS (honest, unlike a claimed win): correctness is verified
+# against the jnp path by the differential suite (test_pallas.py,
+# interpreter mode), but the fusion's device-time advantage is UNMEASURED —
+# the count kernels above measured at parity with XLA's own fusion, and the
+# same may hold here. Like them, this kernel stays opt-in
+# (PILOSA_TPU_PALLAS=1), never the default. Measurement recipe (real chip):
+#   time bsi_range_mask("lt", planes[D=16], sign, exists, pbits, False,
+#   True) vs ops.bsi._range_lt_jnp on the same [16, WORDS_PER_ROW] inputs,
+#   n>=30 dispatches, block_until_ready on the batch; record both ms here.
 
 # Words per grid step of the BSI kernel. D+2 blocks of W_BLK words must fit
 # VMEM with double buffering: 64 planes x 4 KiB x 4 B = 1 MiB per step.
