@@ -378,6 +378,8 @@ class PilosaHTTPServer:
         local = getattr(ex, "local", ex)  # ClusterExecutor wraps Executor
         if hasattr(local, "stacked_stats"):
             out["stacked"] = local.stacked_stats()
+        if self.api.spmd is not None:
+            out["spmd"] = self.api.spmd.stats()
         return RawResponse(_json.dumps(out).encode(), "application/json")
 
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
