@@ -331,7 +331,9 @@ class ClusterExecutor:
                     # (reference: remoteExec posts proto QueryRequests,
                     # executor.go:2414 + http/client.go:268)
                     results, err = self._client(node).query_proto(
-                        idx.name, pql, shards=node_shards, remote=True)
+                        idx.name, pql, shards=node_shards, remote=True,
+                        exclude_row_attrs=opt.exclude_row_attrs,
+                        exclude_columns=opt.exclude_columns)
                     if err:
                         raise ClusterExecError(err)
                     if not results:
@@ -345,7 +347,9 @@ class ClusterExecutor:
                         else r
                 else:
                     resp = self._client(node).query(
-                        idx.name, pql, shards=node_shards, remote=True)
+                        idx.name, pql, shards=node_shards, remote=True,
+                        exclude_row_attrs=opt.exclude_row_attrs,
+                        exclude_columns=opt.exclude_columns)
                     result = result_from_json(resp["results"][0])
                 merge_in(result)
             except Exception as e:
@@ -393,7 +397,16 @@ class ClusterExecutor:
             # the result has the call's natural empty shape (0, empty Row…)
             merged[0] = self.local.execute_call(
                 idx, call, [], self._remote_opt(opt))
-        return finalize_result(call, merged[0])
+        result = finalize_result(call, merged[0])
+        if isinstance(result, Row):
+            # remote partials skip decoration; the coordinator attaches
+            # row attrs / applies exclude options once on the merged Row
+            # (unwrapping Options so the effective call + flags apply)
+            from ..exec.executor import unwrap_options
+
+            eff_call, eff_opt = unwrap_options(call, opt)
+            self.local.attach_row_attrs(idx, eff_call, result, eff_opt)
+        return result
 
     # -- shard discovery -----------------------------------------------------
 

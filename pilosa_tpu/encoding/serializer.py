@@ -27,8 +27,11 @@ TYPE_PAIR = 9
 # -- requests ---------------------------------------------------------------
 
 def encode_query_request(query, shards=None, remote=False,
-                         column_attrs=False):
-    m = pb.QueryRequest(Query=query, Remote=remote, ColumnAttrs=column_attrs)
+                         column_attrs=False, exclude_row_attrs=False,
+                         exclude_columns=False):
+    m = pb.QueryRequest(Query=query, Remote=remote, ColumnAttrs=column_attrs,
+                        ExcludeRowAttrs=exclude_row_attrs,
+                        ExcludeColumns=exclude_columns)
     if shards:
         m.Shards.extend(int(s) for s in shards)
     return m.SerializeToString()
@@ -41,6 +44,8 @@ def decode_query_request(data):
         "shards": list(m.Shards) or None,
         "remote": m.Remote,
         "column_attrs": m.ColumnAttrs,
+        "exclude_row_attrs": m.ExcludeRowAttrs,
+        "exclude_columns": m.ExcludeColumns,
     }
 
 

@@ -111,6 +111,28 @@ def validate_uint_args(call):
         validate_uint_args(filt)
 
 
+def unwrap_options(call, opt):
+    """(inner_call, merged_opt) through Options() wrappers (reference:
+    executeOptionsCall executor.go:244) — the cluster coordinator uses
+    this so result decoration sees the effective call + options."""
+    while call.name == "Options" and call.children:
+        merged = ExecOptions(
+            shards=opt.shards, exclude_columns=opt.exclude_columns,
+            column_attrs=opt.column_attrs,
+            exclude_row_attrs=opt.exclude_row_attrs,
+            remote=opt.remote, profile=opt.profile)
+        for key, value in call.args.items():
+            if key == "excludeColumns":
+                merged.exclude_columns = bool(value)
+            elif key == "columnAttrs":
+                merged.column_attrs = bool(value)
+            elif key == "excludeRowAttrs":
+                merged.exclude_row_attrs = bool(value)
+        opt = merged
+        call = call.children[0]
+    return call, opt
+
+
 def fragment_topn_candidates(frag, use_cache=True):
     """THE per-fragment TopN candidate policy: cache ids when a cache is
     populated (the reference's approximation), else every present row.
@@ -246,13 +268,41 @@ class Executor:
             if plane is not None:
                 planes.append((shard, plane))
         row = Row()
-        if not planes:
-            return row
-        hosts = jax.device_get([p for _, p in planes])
-        for (shard, _), host in zip(planes, hosts):
-            if host.any():
-                row.segments[shard] = host
+        if planes:
+            hosts = jax.device_get([p for _, p in planes])
+            for (shard, _), host in zip(planes, hosts):
+                if host.any():
+                    row.segments[shard] = host
+        if opt.exclude_columns:
+            # strip at the source: remote partials must not ship column
+            # payloads the coordinator would immediately discard
+            row.segments = {}
+        if not opt.remote:
+            self.attach_row_attrs(idx, call, row, opt)
         return row
+
+    def attach_row_attrs(self, idx, call, row, opt):
+        """Coordinator-side Row result decoration (reference:
+        executeBitmapCall executor.go:605-645): plain Row() calls carry
+        the row's attributes unless excludeRowAttrs; excludeColumns strips
+        the column payload (attrs-only responses). Remote partials skip
+        this — only the coordinating node decorates."""
+        if call.name in ("Row", "Range") and not call.has_conditions() \
+                and "from" not in call.args and "to" not in call.args:
+            if opt.exclude_row_attrs:
+                row.attrs = {}
+            else:
+                field_name = call.field_arg()
+                field = idx.field(field_name) if field_name else None
+                row_id = call.args.get(field_name) if field_name else None
+                if field is not None and field.row_attr_store is not None \
+                        and isinstance(row_id, int) \
+                        and not isinstance(row_id, bool):
+                    attrs = field.row_attr_store.attrs(row_id)
+                    if attrs:
+                        row.attrs = attrs
+        if opt.exclude_columns:
+            row.segments = {}
 
     def _zeros(self):
         import jax.numpy as jnp

@@ -82,20 +82,24 @@ class Client:
 
     # -- queries -------------------------------------------------------------
 
-    def query_proto(self, index, pql, shards=None, remote=False):
+    def query_proto(self, index, pql, shards=None, remote=False,
+                    exclude_row_attrs=False, exclude_columns=False):
         """Query over the protobuf data plane (reference:
         InternalClient.QueryNode posts proto QueryRequests). Returns
         (results, err)."""
         from .. import encoding
 
-        body = encoding.encode_query_request(pql, shards=shards,
-                                             remote=remote)
+        body = encoding.encode_query_request(
+            pql, shards=shards, remote=remote,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns)
         data = self._request(
             "POST", f"/index/{index}/query", body,
             content_type=encoding.CONTENT_TYPE_PROTOBUF)
         return encoding.decode_query_response(data)
 
-    def query(self, index, pql, shards=None, remote=False):
+    def query(self, index, pql, shards=None, remote=False,
+              exclude_row_attrs=False, exclude_columns=False):
         """(reference: InternalClient.QueryNode http/client.go:268; remote
         marks node-to-node fan-out requests that must not re-fan-out)"""
         path = f"/index/{index}/query"
@@ -104,6 +108,10 @@ class Client:
             params.append("shards=" + ",".join(str(s) for s in shards))
         if remote:
             params.append("remote=true")
+        if exclude_row_attrs:
+            params.append("excludeRowAttrs=true")
+        if exclude_columns:
+            params.append("excludeColumns=true")
         if params:
             path += "?" + "&".join(params)
         return self._request(

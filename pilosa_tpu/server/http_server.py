@@ -168,8 +168,10 @@ class PilosaHTTPServer:
             from .. import encoding
 
             q = encoding.decode_query_request(req.body)
-            options = ExecOptions(remote=q["remote"],
-                                  column_attrs=q["column_attrs"])
+            options = ExecOptions(
+                remote=q["remote"], column_attrs=q["column_attrs"],
+                exclude_row_attrs=q["exclude_row_attrs"],
+                exclude_columns=q["exclude_columns"])
             try:
                 results = self.api.query(
                     req.params["index"], q["query"], shards=q["shards"],
@@ -191,7 +193,11 @@ class PilosaHTTPServer:
             req.query.get("columnAttrs", ["false"])[0] == "true"
         options = ExecOptions(
             remote=req.query.get("remote", ["false"])[0] == "true",
-            column_attrs=column_attrs)
+            column_attrs=column_attrs,
+            exclude_columns=req.query.get(
+                "excludeColumns", ["false"])[0] == "true",
+            exclude_row_attrs=req.query.get(
+                "excludeRowAttrs", ["false"])[0] == "true")
         results = self.api.query(
             req.params["index"], pql, shards=shards, options=options)
         out = {"results": [result_to_json(r) for r in results]}

@@ -466,3 +466,39 @@ def test_shard_discovery_gossips_not_polls():
             Client.index_shards = orig
     finally:
         h.close()
+
+
+def test_row_attrs_and_excludes_across_nodes():
+    """Row attrs attach ONCE on the coordinator (remote partials skip
+    decoration) and Options-wrapped exclude flags apply in a cluster —
+    the unwrap must happen before coordinator-side decoration."""
+    import time
+
+    h = ClusterHarness(3, replica_n=1)
+    try:
+        h[0].client.create_index("ra")
+        h[0].client.create_field("ra", "f")
+        time.sleep(0.2)
+        cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+        h[0].client.import_bits("ra", "f", [10] * len(cols), cols)
+        h[0].client.query("ra", 'SetRowAttrs(f, 10, color="red")')
+        time.sleep(0.3)  # attr fan-out settles
+
+        for node in h.nodes:
+            got = node.client.query("ra", "Row(f=10)")["results"][0]
+            assert got["attrs"] == {"color": "red"}
+            assert sorted(got["columns"]) == sorted(cols)
+
+            got = node.client.query(
+                "ra", "Options(Row(f=10), excludeColumns=true)"
+            )["results"][0]
+            assert got["attrs"] == {"color": "red"}
+            assert got["columns"] == []
+
+            got = node.client.query(
+                "ra", "Options(Row(f=10), excludeRowAttrs=true)"
+            )["results"][0]
+            assert got["attrs"] == {}
+            assert sorted(got["columns"]) == sorted(cols)
+    finally:
+        h.close()

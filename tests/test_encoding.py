@@ -16,7 +16,8 @@ def test_query_request_roundtrip():
         "Count(Row(f=1))", shards=[0, 5], remote=True)
     q = encoding.decode_query_request(blob)
     assert q == {"query": "Count(Row(f=1))", "shards": [0, 5],
-                 "remote": True, "column_attrs": False}
+                 "remote": True, "column_attrs": False,
+                 "exclude_row_attrs": False, "exclude_columns": False}
 
 
 def test_result_types_roundtrip():

@@ -530,3 +530,32 @@ def test_time_clear_across_quantum_views(tmp_path):
         got = cols(e.execute("i", check)[0])
         assert got == expected, (quantum, got, expected)
         h.close()
+
+
+def test_row_attrs_attached_and_exclude_options(tmp_path):
+    """Row() responses carry the row's attributes; excludeRowAttrs strips
+    them and excludeColumns strips the column payload (reference:
+    executeBitmapCall executor.go:605-645 + executeOptionsCall)."""
+    from pilosa_tpu.server.api import API
+
+    holder = Holder(str(tmp_path / "ra")).open()
+    api = API(holder)
+    api.create_index("i")
+    api.create_field("i", "f")
+    api.query("i", "Set(3, f=10)")
+    api.query("i", 'SetRowAttrs(f, 10, color="red", rank=7)')
+
+    row = api.query("i", "Row(f=10)")[0]
+    assert row.attrs == {"color": "red", "rank": 7}
+    assert cols(row) == [3]
+
+    row = api.query(
+        "i", "Options(Row(f=10), excludeRowAttrs=true)")[0]
+    assert not row.attrs
+    assert cols(row) == [3]
+
+    row = api.query(
+        "i", "Options(Row(f=10), excludeColumns=true)")[0]
+    assert row.attrs == {"color": "red", "rank": 7}
+    assert cols(row) == []
+    holder.close()
