@@ -36,6 +36,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 import json
+import os
 import sys
 import time
 import traceback
@@ -267,56 +268,192 @@ def main():
     }))
 
 
-def _watchdog(seconds: float = 540.0):
-    """Hard deadline for the whole bench: the remote-device tunnel can
-    hang so completely that even backend init blocks forever (observed;
-    see .claude/skills/verify/SKILL.md gotchas), which no in-thread retry
-    can catch. Emit the one JSON line and hard-exit so the driver's
-    BENCH_r{N}.json never comes up empty."""
-    import os
+# ---------------------------------------------------------------------------
+# Orchestration: per-attempt subprocess isolation.
+#
+# The remote-device tunnel can hang so completely that even backend init
+# blocks forever (observed repeatedly; .claude/skills/verify/SKILL.md
+# gotchas). A hang never raises, so an in-process retry loop is dead code
+# for exactly that failure: attempt 1 eats the whole budget. Instead the
+# parent process (which never imports jax, so it cannot itself hang on
+# backend init) runs each attempt in a FRESH subprocess with two deadlines:
+#   - probe deadline (~75s): the child must finish backend init + one
+#     trivial jit and print a marker on stderr, else it is killed and the
+#     next attempt starts — a hung tunnel costs ~75s, not the whole budget;
+#   - full deadline: the remaining overall budget.
+# The parent emits exactly ONE JSON line on stdout: the child's line on
+# success, else the last error seen, so BENCH_r{N}.json never comes up
+# empty. Env knobs (mainly for tests): PILOSA_TPU_BENCH_BUDGET (total s),
+# PILOSA_TPU_BENCH_PROBE (probe s), PILOSA_TPU_BENCH_ATTEMPTS,
+# PILOSA_TPU_BENCH_FAKE (child stub: ok|error|hang|hang_after_probe).
+
+PROBE_MARKER = "__PILOSA_BENCH_PROBE_OK__"
+_CHILD_ENV = "PILOSA_TPU_BENCH_CHILD"
+
+
+def _child() -> None:
+    """One bench attempt: probe (backend init + trivial jit), marker,
+    then the full measurement. Runs inside its own process; the parent
+    enforces all deadlines, so no watchdog lives here."""
+    fake = os.environ.get("PILOSA_TPU_BENCH_FAKE", "")
+    if fake:
+        _child_fake(fake)
+        return
+    import jax
+    import jax.numpy as jnp
+
+    # Site hooks (axon sitecustomize) force-select the tunnel platform at
+    # interpreter start, overriding JAX_PLATFORMS; a bench explicitly run
+    # with JAX_PLATFORMS=cpu must actually get cpu.
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    jax.devices()  # the observed hang point: tunnel backend init
+    int(jax.jit(lambda v: v + 1)(jnp.int32(1)))  # trivial jit round trip
+    print(PROBE_MARKER, file=sys.stderr, flush=True)
+    main()
+
+
+def _child_fake(mode: str) -> None:
+    """Deterministic child stand-ins so tests can drive the orchestrator
+    without jax: ok | error | hang | hang_after_probe."""
+    if mode == "hang":
+        time.sleep(3600)
+    print(PROBE_MARKER, file=sys.stderr, flush=True)
+    if mode == "hang_after_probe":
+        time.sleep(3600)
+    elif mode == "error":
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0, "error": "fake failure"}))
+        sys.exit(1)
+    else:
+        print(json.dumps({"metric": "fake", "value": 1.0, "unit": "qps",
+                          "vs_baseline": 1.0}))
+
+
+def _run_attempt(remaining: float, probe_deadline: float):
+    """Spawn one child attempt; return its parsed JSON record or None.
+
+    Kills the child on a missed probe or full deadline. stderr is
+    forwarded (it is diagnostics, not contract); stdout is captured and
+    the last parseable JSON object line wins.
+    """
+    import subprocess
     import threading
 
-    def fire():
+    env = dict(os.environ, **{_CHILD_ENV: "1"})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env)
+
+    probe_ok = threading.Event()
+    out_lines: list = []
+
+    def pump_err():
+        for line in proc.stderr:
+            if PROBE_MARKER in line:
+                probe_ok.set()
+            else:
+                sys.stderr.write(line)
+
+    def pump_out():
+        for line in proc.stdout:
+            out_lines.append(line)
+
+    te = threading.Thread(target=pump_err, daemon=True)
+    to = threading.Thread(target=pump_out, daemon=True)
+    te.start()
+    to.start()
+
+    def kill(reason: str):
+        print(f"bench: killing attempt ({reason})", file=sys.stderr,
+              flush=True)
+        proc.kill()
+        proc.wait()
+
+    t0 = time.perf_counter()
+    if not probe_ok.wait(timeout=min(probe_deadline, remaining)):
+        kill(f"probe missed {probe_deadline:.0f}s deadline — tunnel hung?")
+        return None
+    # Full-run deadline = budget actually left, not budget minus the
+    # probe's worst case — a 5s probe must not forfeit 70s of bench time.
+    try:
+        proc.wait(timeout=max(remaining - (time.perf_counter() - t0), 5.0))
+    except subprocess.TimeoutExpired:
+        kill("full-run deadline")
+        return None
+    te.join(timeout=5)
+    to.join(timeout=5)
+    for line in reversed(out_lines):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in rec:
+                return rec
+    return None
+
+
+def orchestrate() -> None:
+    import threading
+
+    budget = float(os.environ.get("PILOSA_TPU_BENCH_BUDGET", "520"))
+    probe = float(os.environ.get("PILOSA_TPU_BENCH_PROBE", "75"))
+    attempts = int(os.environ.get("PILOSA_TPU_BENCH_ATTEMPTS", "4"))
+
+    # Belt-and-braces: if the parent itself is ever wedged past budget
+    # (it should not be — every wait above is bounded), still emit the
+    # one JSON line before dying.
+    def last_resort():
         print(json.dumps({
-            "metric": "error", "value": 0, "unit": "",
-            "vs_baseline": 0,
-            "error": f"bench watchdog: no result within {seconds:.0f}s "
-                     "(device tunnel hung?)",
+            "metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
+            "error": f"bench parent watchdog: no result within "
+                     f"{budget + 30:.0f}s",
         }), flush=True)
         os._exit(1)
 
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    return t
+    timer = threading.Timer(budget + 30, last_resort)
+    timer.daemon = True
+    timer.start()
 
-
-def main_with_retry(attempts: int = 3) -> None:
-    """Run main(), retrying transient failures (flaky backend init, device
-    grab races). Always emits exactly one JSON line: on total failure, an
-    error record instead of silence, so the driver's BENCH_r{N}.json never
-    comes up empty."""
-    timer = _watchdog()
-    last = None
+    t0 = time.perf_counter()
+    last_err = None
     for attempt in range(attempts):
-        try:
-            main()
-            timer.cancel()  # success emitted: the deadline must not
-            return          # fire a second JSON record afterwards
-        except SystemExit:
-            raise
-        except Exception as exc:  # noqa: BLE001 — last-resort bench guard
-            last = exc
-            traceback.print_exc(file=sys.stderr)
-            time.sleep(2.0 * (attempt + 1))
+        remaining = budget - (time.perf_counter() - t0)
+        if remaining < 30:
+            break
+        print(f"bench: attempt {attempt + 1}/{attempts}, "
+              f"{remaining:.0f}s budget left", file=sys.stderr, flush=True)
+        rec = _run_attempt(remaining, probe)
+        if rec is not None and rec.get("metric") != "error":
+            timer.cancel()
+            print(json.dumps(rec), flush=True)
+            return
+        if rec is not None:
+            last_err = rec
+        time.sleep(2.0)
     timer.cancel()
-    print(json.dumps({
-        "metric": "error", "value": 0, "unit": "",
-        "vs_baseline": 0,
-        "error": f"{type(last).__name__}: {last}",
-    }))
+    print(json.dumps(last_err or {
+        "metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
+        "error": "bench: all attempts missed the probe/full deadline "
+                 "(device tunnel hung?)",
+    }), flush=True)
     sys.exit(1)
 
 
 if __name__ == "__main__":
-    main_with_retry()
+    if os.environ.get(_CHILD_ENV):
+        try:
+            _child()
+        except Exception as exc:  # noqa: BLE001 — child-level last resort
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
+                "error": f"{type(exc).__name__}: {exc}",
+            }), flush=True)
+            sys.exit(1)
+    else:
+        orchestrate()

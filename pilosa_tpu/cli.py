@@ -56,21 +56,7 @@ def cmd_server(args):
     from .core import Holder
     from .server import API, PilosaHTTPServer
 
-    config = load_config(args.config)
-    if args.bind:
-        config["bind"] = args.bind
-    if args.data_dir:
-        config["data-dir"] = args.data_dir
-    if getattr(args, "cluster_hosts", None):
-        config["cluster-hosts"] = args.cluster_hosts
-    if getattr(args, "node_id", None):
-        config["node-id"] = args.node_id
-    if getattr(args, "replicas", None):
-        config["replicas"] = args.replicas
-    if getattr(args, "spmd", False):
-        config["spmd"] = True
-    if getattr(args, "spmd_port", None):
-        config["spmd-port"] = args.spmd_port
+    config = _apply_server_flags(load_config(args.config), args)
     host, _, port = config["bind"].partition(":")
     data_dir = os.path.expanduser(config["data-dir"])
 
@@ -187,14 +173,11 @@ def cmd_server(args):
         monitor = HealthMonitor(cluster, Client).start()
 
     # Slow-query threshold (reference: long-query-time server/config.go);
-    # flag wins over config file; unset disables the log.
-    lqt = getattr(args, "long_query_time", None) \
-        or config.get("long-query-time")
-    # write-batch cap (reference: max-writes-per-request
-    # server/config.go); <=0 disables
-    mwpr = getattr(args, "max_writes_per_request", None)
-    if mwpr is None:
-        mwpr = config.get("max-writes-per-request", 0)
+    # unset disables the log. Write-batch cap (reference:
+    # max-writes-per-request server/config.go); <=0 disables. Both already
+    # flag-merged by _apply_server_flags.
+    lqt = config.get("long-query-time")
+    mwpr = config.get("max-writes-per-request", 0)
     spmd = None
     if spmd_requested and cluster is not None:
         from .cluster.spmd import SpmdDataPlane
@@ -618,6 +601,57 @@ def cmd_check(args):
     return 1 if failed else 0
 
 
+def _toml_value(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    if isinstance(v, dict):  # inline table (e.g. [[cluster.nodes]] entries)
+        inner = ", ".join(f"{k} = {_toml_value(v[k])}" for k in sorted(v))
+        return "{" + inner + "}"
+    return json.dumps(str(v))
+
+
+def _apply_server_flags(config, args):
+    """Fold server-command flags into a loaded config — the single merge
+    used by BOTH `server` and `config`, so what `config` prints is exactly
+    what `server` runs with (reference: cmd/root.go setAllConfig does this
+    once via viper for every subcommand)."""
+    for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
+                 "replicas", "spmd_port", "long_query_time",
+                 "max_writes_per_request"):
+        val = getattr(args, flag, None)
+        if val is not None:
+            config[flag.replace("_", "-")] = val
+    if getattr(args, "spmd", False):
+        config["spmd"] = True
+    return config
+
+
+def cmd_config(args):
+    """Print the EFFECTIVE merged configuration — file < env < flags — as
+    TOML (reference: cmd/root.go:71-78 registers ctl/config.go, whose Run
+    marshals the fully-populated server.Config that viper merged from all
+    three sources). `generate-config` prints defaults; this prints what
+    the server would actually run with."""
+    config = _apply_server_flags(load_config(args.config), args)
+    from .shardwidth import EXPONENT
+
+    config.setdefault("shard-width-exponent", EXPONENT)
+    scalars = {k: v for k, v in config.items() if not isinstance(v, dict)}
+    tables = {k: v for k, v in config.items() if isinstance(v, dict)}
+    for key in sorted(scalars):
+        print(f"{key} = {_toml_value(scalars[key])}")
+    for name in sorted(tables):
+        print()
+        print(f"[{name}]")
+        for key in sorted(tables[name]):
+            print(f"{key} = {_toml_value(tables[name][key])}")
+    return 0
+
+
 def cmd_generate_config(args):
     """(reference: ctl/generate_config.go) Print default TOML config."""
     print('bind = "127.0.0.1:10101"')
@@ -737,6 +771,21 @@ def main(argv=None):
 
     p = sub.add_parser("generate-config", help="print default config TOML")
     p.set_defaults(fn=cmd_generate_config)
+
+    p = sub.add_parser(
+        "config", help="print the effective merged config as TOML "
+                       "(file < env < flags)")
+    p.add_argument("--config", default=None)
+    p.add_argument("--bind", default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--cluster-hosts", default=None)
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--spmd", action="store_true", default=False)
+    p.add_argument("--spmd-port", type=int, default=None)
+    p.add_argument("--long-query-time", default=None)
+    p.add_argument("--max-writes-per-request", type=int, default=None)
+    p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)
     return args.fn(args)

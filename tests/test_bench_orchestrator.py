@@ -1,0 +1,100 @@
+"""bench.py orchestration: per-attempt subprocess isolation.
+
+The failure this guards against is a remote-device tunnel that hangs
+without raising (observed: backend init blocks forever), which an
+in-process retry loop cannot recover from — the round-4 bench died
+exactly that way. The orchestrator must (a) kill a child that misses the
+probe deadline and start a fresh one, (b) kill a child that probes fine
+but then wedges, (c) propagate a child's error record, (d) always emit
+exactly one JSON line on stdout. Children are stubbed via
+PILOSA_TPU_BENCH_FAKE so no jax backend is involved.
+
+Reference analog: the bench harness around roaring_test.go benchmarks —
+but the deadline/retry structure is this environment's requirement, not
+the reference's.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def run_bench(fake, budget="45", probe="3", attempts="2", timeout=90):
+    env = dict(
+        os.environ,
+        PILOSA_TPU_BENCH_FAKE=fake,
+        PILOSA_TPU_BENCH_BUDGET=budget,
+        PILOSA_TPU_BENCH_PROBE=probe,
+        PILOSA_TPU_BENCH_ATTEMPTS=attempts,
+    )
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        env=env, timeout=timeout)
+    elapsed = time.perf_counter() - t0
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines!r}"
+    return proc.returncode, json.loads(lines[0]), elapsed
+
+
+def test_success_passthrough():
+    code, rec, _ = run_bench("ok")
+    assert code == 0
+    assert rec["metric"] == "fake"
+    assert rec["value"] == 1.0
+
+
+def test_hung_probe_killed_and_retried():
+    # Probe deadline 3s, two attempts: both children hang before the
+    # probe marker, each must be killed at ~3s — total well under the
+    # budget, proving a hang costs one probe window, not everything.
+    code, rec, elapsed = run_bench("hang", attempts="2")
+    assert code == 1
+    assert rec["metric"] == "error"
+    assert "probe" in rec["error"] or "deadline" in rec["error"]
+    assert elapsed < 30, f"hang attempts not bounded: {elapsed:.1f}s"
+
+
+def test_hang_after_probe_killed_on_full_deadline():
+    # Child probes OK then wedges; the full-run deadline (remaining
+    # budget) must reap it.
+    code, rec, elapsed = run_bench(
+        "hang_after_probe", budget="40", probe="2", attempts="1",
+        timeout=120)
+    assert code == 1
+    assert rec["metric"] == "error"
+    assert elapsed < 60
+
+
+def test_child_error_record_propagates():
+    code, rec, _ = run_bench("error")
+    assert code == 1
+    assert rec["error"] == "fake failure"
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PILOSA_TPU_BENCH_E2E"),
+    reason="several-minute full bench; set PILOSA_TPU_BENCH_E2E=1 to run")
+def test_real_child_cpu_path():
+    # The genuine measurement path on the CPU fallback scale: probe,
+    # marker, full run, one well-formed JSON record with the serving
+    # extras the driver archives.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PILOSA_TPU_BENCH_FAKE", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        env=env, timeout=520)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert rec["metric"].startswith("pql_intersect_count_qps")
+    assert rec["value"] > 0
+    assert "kernel_qps" in rec["extra"]
+    assert "served" in rec["extra"]
