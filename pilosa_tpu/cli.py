@@ -236,11 +236,20 @@ def cmd_server(args):
 
     tls_cfg = config.get("tls", {}) if isinstance(
         config.get("tls", {}), dict) else {}
+    # CORS (reference: handler.allowed-origins server/config.go:75)
+    origins = config.get("handler", {}).get("allowed-origins", []) \
+        if isinstance(config.get("handler", {}), dict) else []
+    if getattr(args, "allowed_origins", None):
+        origins = args.allowed_origins
+    if isinstance(origins, str):  # scalar TOML value / comma-joined flag
+        origins = origins.split(",")
+    origins = [o.strip() for o in origins if o.strip()]
     server = PilosaHTTPServer(
         api, host=host, port=int(port or 10101), stats=stats,
         tls_cert=getattr(args, "tls_certificate", None)
         or tls_cfg.get("certificate"),
-        tls_key=getattr(args, "tls_key", None) or tls_cfg.get("key"))
+        tls_key=getattr(args, "tls_key", None) or tls_cfg.get("key"),
+        allowed_origins=origins)
     server.start()
     if join_needed:
         # Register with the coordinator now that we can serve the resize
@@ -714,6 +723,9 @@ def main(argv=None):
     p.add_argument("--tls-certificate", default=None,
                    help="PEM certificate file; serves HTTPS when set")
     p.add_argument("--tls-key", default=None, help="PEM key file")
+    p.add_argument("--allowed-origins", default=None,
+                   help="comma-separated CORS origins browsers may query "
+                        "from ('*' allows all); no CORS headers when unset")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")

@@ -212,6 +212,16 @@ class Cluster:
                 out |= per_index.get(index, set())
         return out
 
+    def remove_remote_shard(self, index, shard):
+        """Drop ONE advertised shard from every peer's record (reference:
+        Field.RemoveAvailableShard field.go:513, reached via DELETE
+        remote-available-shards handler.go:316 — stale-advertisement
+        cleanup). The next gossip push from a peer that really has the
+        shard re-adds it."""
+        with self._lock:
+            for per_index in self._remote_shards.values():
+                per_index.get(index, set()).discard(int(shard))
+
     def drop_remote_index(self, index):
         with self._lock:
             for per_index in self._remote_shards.values():

@@ -8,6 +8,7 @@ attached; single-node mode permits everything.
 
 import io
 import csv
+import threading
 import time
 
 import numpy as np
@@ -137,10 +138,22 @@ class API:
                 # evaluation instead of building a second evaluator
                 spmd._local_exec = self.executor.local
             self.resize = ResizeManager(holder, cluster, self.client_factory)
+            # Writes arriving while RESIZING are queued and replayed once
+            # the cluster returns to NORMAL (see import_bits); the resize
+            # manager pings us at every RESIZING->NORMAL transition,
+            # including on followers and aborts.
+            self.resize.on_state_normal = self._drain_resize_writes
         else:
             self.executor = Executor(
                 holder, max_writes_per_request=max_writes_per_request)
             self.resize = None
+        self._resize_writes = []  # queued (kind, kwargs) during RESIZING
+        self._resize_writes_lock = threading.Lock()
+        self._resize_draining = False  # replay thread active
+        # marks the replay thread itself: ITS imports must apply, not
+        # re-queue (the queue-while-draining rule is for new client
+        # writes, which wait their turn behind the backlog)
+        self._resize_replay_tls = threading.local()
 
     def spmd_step(self, step):
         """Execute one SPMD collective step announced by the coordinator
@@ -156,6 +169,80 @@ class API:
         api.validate api.go:119 + apimethod_string.go)."""
         if self.cluster is not None and self.cluster.state == "RESIZING":
             raise ApiError("cluster is resizing; try again later")
+
+    # Queue cap: past this, imports get the reference's RESIZING rejection
+    # instead (backpressure; a resize should finish long before a client
+    # can push 10k batches).
+    RESIZE_QUEUE_MAX = 10_000
+
+    def _queue_resize_write(self, kind, kwargs):
+        """True = the write was queued for post-resize replay (caller
+        returns immediately); False = cluster not resizing, proceed.
+
+        The state re-check happens INSIDE the queue lock, which the drain
+        also holds for its swap: either this append lands before a swap
+        (drained), or the drain already ran — in which case the state is
+        NORMAL here and the write proceeds normally. While a drain is
+        replaying, new writes keep queueing behind it so replay order is
+        arrival order (a stale queued value must not clobber a newer
+        acknowledged one)."""
+        if self.cluster is None:
+            return False
+        if getattr(self._resize_replay_tls, "active", False):
+            return False  # the drain's own replay: apply directly
+        if kwargs.get("remote"):
+            # Internal fan-out hop, not a client write: queueing would
+            # replay it LOCALLY on a node the resize may have just
+            # de-ownered. Reject like the reference; the coordinating
+            # node's degraded-write policy reports the failure.
+            self._validate_state()
+            return False
+        with self._resize_writes_lock:
+            if self.cluster.state != "RESIZING" \
+                    and not self._resize_draining:
+                return False
+            if len(self._resize_writes) >= self.RESIZE_QUEUE_MAX:
+                raise ApiError("cluster is resizing; try again later "
+                               "(write queue full)")
+            self._resize_writes.append((kind, kwargs))
+        return True
+
+    def _drain_resize_writes(self):
+        """Replay queued imports after a RESIZING->NORMAL transition
+        (resize completion OR abort): routing now follows the installed
+        topology, so every queued bit lands on its owners. Runs on a
+        background thread — the resize manager calls this while holding
+        its own lock, and replay fans out over HTTP. Loops until the
+        queue is empty so writes arriving mid-drain replay after the
+        backlog, preserving arrival order."""
+        with self._resize_writes_lock:
+            if self._resize_draining or not self._resize_writes:
+                return
+            self._resize_draining = True
+
+        def replay():
+            self._resize_replay_tls.active = True
+            while True:
+                with self._resize_writes_lock:
+                    queued = self._resize_writes
+                    self._resize_writes = []
+                    if not queued:
+                        self._resize_draining = False
+                        return
+                for kind, kwargs in queued:
+                    try:
+                        if kind == "bits":
+                            self.import_bits(**kwargs)
+                        else:
+                            self.import_values(**kwargs)
+                    except Exception:
+                        self.logger.printf(
+                            "resize write replay failed: %s %r", kind,
+                            {k: kwargs[k] for k in
+                             ("index_name", "field_name")})
+
+        threading.Thread(target=replay, daemon=True,
+                         name="resize-write-drain").start()
 
     def query(self, index_name, pql, shards=None, options=None):
         """(reference: api.Query api.go:135)"""
@@ -530,9 +617,25 @@ class API:
                     row_keys=None, column_keys=None):
         """(reference: api.Import api.go:920 — sort bits by shard, forward
         each slice to all replica owners concurrently; string keys are
-        translated here, on the coordinating node)"""
-        self._validate_state()
+        translated here, on the coordinating node)
+
+        During RESIZING the reference rejects imports outright (api.go:101
+        methodsResizing admits only fragmentData/abort); we instead QUEUE
+        them and replay once the cluster returns to NORMAL — by the
+        then-installed topology, so completion AND abort both land every
+        bit (policy documented in PARITY.md). The queue is process-memory:
+        bounded, and lost on a crash like any unflushed WAL tail.
+        Index/field existence is validated BEFORE queueing (DDL is blocked
+        while RESIZING, so the check stays valid at replay) — a doomed
+        import must 404 now, not vanish into a replay-time log line."""
         field = self._field(index_name, field_name)
+        if self._queue_resize_write(
+                "bits", dict(index_name=index_name, field_name=field_name,
+                             row_ids=row_ids, column_ids=column_ids,
+                             timestamps=timestamps, clear=clear,
+                             remote=remote, row_keys=row_keys,
+                             column_keys=column_keys)):
+            return 0
         if row_keys is not None or column_keys is not None:
             t_rows, t_cols = self._translate_import_keys(
                 index_name, field_name, row_keys, column_keys)
@@ -592,8 +695,12 @@ class API:
 
     def import_values(self, index_name, field_name, column_ids, values,
                       remote=False, column_keys=None):
-        self._validate_state()
         field = self._field(index_name, field_name)
+        if self._queue_resize_write(
+                "values", dict(index_name=index_name, field_name=field_name,
+                               column_ids=column_ids, values=values,
+                               remote=remote, column_keys=column_keys)):
+            return 0
         if column_keys is not None:
             _, column_ids = self._translate_import_keys(
                 index_name, field_name, None, column_keys)
@@ -756,6 +863,26 @@ class API:
         if idx is None:
             raise NotFoundError(f"index not found: {index_name}")
         return {"shards": idx.available_shards()}
+
+    def shard_nodes(self, index_name, shard):
+        """Owner nodes of one shard, as node JSON (reference:
+        api.ShardNodes api.go:1086, served by handler.go:311)."""
+        if self.cluster is None:
+            return [{"id": "local", "isCoordinator": True}]
+        return [n.to_json()
+                for n in self.cluster.shard_nodes(index_name, int(shard))]
+
+    def delete_available_shard(self, index_name, field_name, shard):
+        """Forget a remotely-advertised shard for a field (reference:
+        api.DeleteAvailableShard api.go:1266 -> Field.RemoveAvailableShard
+        field.go:513; used when a remote's shard advertisement turns out
+        stale). Our shard availability is tracked per-index in the
+        gossiped shard map, so removal drops the shard from every peer's
+        record for the index."""
+        self._field(index_name, field_name)  # 404 on unknown index/field
+        if self.cluster is not None:
+            self.cluster.remove_remote_shard(index_name, int(shard))
+        return None
 
     def _fragment(self, index_name, field_name, view_name, shard):
         field = self._field(index_name, field_name)

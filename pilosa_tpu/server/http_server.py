@@ -28,7 +28,7 @@ class PilosaHTTPServer:
     """Owns the listening socket and the route table."""
 
     def __init__(self, api, host="127.0.0.1", port=10101, stats=None,
-                 tls_cert=None, tls_key=None):
+                 tls_cert=None, tls_key=None, allowed_origins=None):
         from ..utils.stats import global_stats
 
         self.api = api
@@ -40,6 +40,9 @@ class PilosaHTTPServer:
         # TLS (reference: server/tlsconfig.go; config tls.certificate/key)
         self.tls_cert = tls_cert
         self.tls_key = tls_key
+        # CORS (reference: http/handler.go:83-91 OptHandlerAllowedOrigins):
+        # origins allowed to hit the API from a browser; "*" allows all.
+        self.allowed_origins = list(allowed_origins or [])
         self.routes = self._build_routes()
         self._httpd = None
         self._thread = None
@@ -87,6 +90,12 @@ class PilosaHTTPServer:
             Route("POST", r"/internal/spmd/initiate",
                   self._post_spmd_initiate),
             Route("GET", r"/internal/spmd/stats", self._get_spmd_stats),
+            Route("GET", r"/internal/fragment/nodes",
+                  self._get_fragment_nodes),
+            Route("DELETE",
+                  r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
+                  r"/remote-available-shards/(?P<shard>[0-9]+)",
+                  self._delete_remote_available_shard),
             Route("GET", r"/internal/fragment/blocks",
                   self._get_fragment_blocks),
             Route("GET", r"/internal/fragment/block/data",
@@ -306,6 +315,25 @@ class PilosaHTTPServer:
     def _q1(self, req, key, default=None):
         return req.query.get(key, [default])[0]
 
+    def _get_fragment_nodes(self, req):
+        """Owner nodes of one shard (reference: http/handler.go:311
+        handleGetFragmentNodes — a stock internal client resolves fragment
+        placement through this exact path)."""
+        shard = self._q1(req, "shard")
+        if shard is None or not shard.isdigit():
+            raise ApiError("shard should be an unsigned integer")
+        index = self._q1(req, "index")
+        if not index:
+            raise ApiError("index required")
+        return self.api.shard_nodes(index, int(shard))
+
+    def _delete_remote_available_shard(self, req):
+        """(reference: http/handler.go:316 handleDeleteRemoteAvailableShard)"""
+        self.api.delete_available_shard(
+            req.params["index"], req.params["field"],
+            int(req.params["shard"]))
+        return {"success": True}
+
     def _get_fragment_blocks(self, req):
         return self.api.fragment_blocks(
             self._q1(req, "index"), self._q1(req, "field"),
@@ -442,7 +470,7 @@ class PilosaHTTPServer:
             def _dispatch(self):
                 server.dispatch(self)
 
-            do_GET = do_POST = do_DELETE = _dispatch
+            do_GET = do_POST = do_DELETE = do_OPTIONS = _dispatch
 
         # Stdlib default listen backlog is 5: a burst of concurrent
         # clients (the serving workload the batched count path exists
@@ -485,6 +513,20 @@ class PilosaHTTPServer:
 
     # -- dispatch ------------------------------------------------------------
 
+    def _cors_origin(self, handler):
+        """The Access-Control-Allow-Origin value for this request, or None
+        (reference: http/handler.go:83-91 OptHandlerAllowedOrigins wraps
+        the router in gorilla handlers.CORS; absent the option, no CORS
+        headers are emitted and browsers refuse cross-origin reads)."""
+        if not self.allowed_origins:
+            return None
+        origin = handler.headers.get("Origin")
+        if origin is None:
+            return None
+        if "*" in self.allowed_origins:
+            return "*"
+        return origin if origin in self.allowed_origins else None
+
     def dispatch(self, handler):
         from ..utils import tracing
 
@@ -493,6 +535,23 @@ class PilosaHTTPServer:
         query = parse_qs(parsed.query)
         length = int(handler.headers.get("Content-Length", 0))
         body = handler.rfile.read(length) if length else b""
+
+        cors = self._cors_origin(handler)
+        if handler.command == "OPTIONS":
+            # Preflight: answer with the allowed surface, no body.
+            handler.send_response(200 if cors else 403)
+            if self.allowed_origins:
+                # response varies by Origin -> shared caches must key on it
+                handler.send_header("Vary", "Origin")
+            if cors:
+                handler.send_header("Access-Control-Allow-Origin", cors)
+                handler.send_header("Access-Control-Allow-Methods",
+                                    "GET, POST, DELETE, OPTIONS")
+                handler.send_header("Access-Control-Allow-Headers",
+                                    "Content-Type")
+            handler.send_header("Content-Length", "0")
+            handler.end_headers()
+            return
 
         import time as _time
 
@@ -534,6 +593,10 @@ class PilosaHTTPServer:
         handler.send_response(status)
         handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(data)))
+        if self.allowed_origins:
+            handler.send_header("Vary", "Origin")
+        if cors:
+            handler.send_header("Access-Control-Allow-Origin", cors)
         handler.end_headers()
         handler.wfile.write(data)
         self.stats.timing(

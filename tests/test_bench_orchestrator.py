@@ -25,7 +25,10 @@ import pytest
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 
-def run_bench(fake, budget="45", probe="3", attempts="2", timeout=90):
+def run_bench(fake, budget="60", probe="10", attempts="2", timeout=120):
+    # probe=10s, not lower: a loaded CI box can take seconds just to fork
+    # python + import numpy, and a flaky pass/fail here would discredit
+    # the orchestrator the driver depends on.
     env = dict(
         os.environ,
         PILOSA_TPU_BENCH_FAKE=fake,
@@ -51,25 +54,25 @@ def test_success_passthrough():
 
 
 def test_hung_probe_killed_and_retried():
-    # Probe deadline 3s, two attempts: both children hang before the
-    # probe marker, each must be killed at ~3s — total well under the
-    # budget, proving a hang costs one probe window, not everything.
+    # Two attempts: both children hang before the probe marker, each must
+    # be killed at ~probe deadline — total well under the budget, proving
+    # a hang costs one probe window, not everything.
     code, rec, elapsed = run_bench("hang", attempts="2")
     assert code == 1
     assert rec["metric"] == "error"
     assert "probe" in rec["error"] or "deadline" in rec["error"]
-    assert elapsed < 30, f"hang attempts not bounded: {elapsed:.1f}s"
+    assert elapsed < 50, f"hang attempts not bounded: {elapsed:.1f}s"
 
 
 def test_hang_after_probe_killed_on_full_deadline():
     # Child probes OK then wedges; the full-run deadline (remaining
     # budget) must reap it.
     code, rec, elapsed = run_bench(
-        "hang_after_probe", budget="40", probe="2", attempts="1",
+        "hang_after_probe", budget="40", probe="10", attempts="1",
         timeout=120)
     assert code == 1
     assert rec["metric"] == "error"
-    assert elapsed < 60
+    assert elapsed < 75
 
 
 def test_child_error_record_propagates():

@@ -28,7 +28,8 @@ def regimes(rng):
         "sparse": sparse,
         "dense": dense,
         "runs": runs,
-        "block": set(range(0, 70000)),
+        # clamp to the shard: at SHARD_EXP=16 this is a full-shard block
+        "block": set(range(0, min(70000, SHARD_WIDTH))),
     }
 
 

@@ -10,7 +10,7 @@ import pytest
 import jax.numpy as jnp
 
 from pilosa_tpu.ops import bsi, bitplane
-from pilosa_tpu.shardwidth import WORDS_PER_ROW
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 
 from .naive import bsi_planes, plane_of, set_of
 
@@ -19,7 +19,7 @@ DEPTH = 12
 
 
 def make_values(rng, n=2000, lo=-3000, hi=3000):
-    cols = rng.choice(100_000, size=n, replace=False)
+    cols = rng.choice(min(100_000, SHARD_WIDTH), size=n, replace=False)
     vals = rng.integers(lo, hi, size=n)
     return {int(c): int(v) for c, v in zip(cols, vals)}
 
@@ -85,7 +85,7 @@ def test_range_between_unsigned(rng):
 def test_sum_counts(rng):
     values = make_values(rng)
     planes, sign, exists = dev(values)
-    full = jnp.asarray(plane_of(set(range(0, 100_000))))
+    full = jnp.asarray(plane_of(set(range(0, min(100_000, SHARD_WIDTH)))))
     pos, neg, count = bsi.bsi_plane_counts(planes, sign, exists, full)
     pos, neg = np.asarray(pos), np.asarray(neg)
     total = sum(int(pos[i]) << i for i in range(DEPTH)) - sum(

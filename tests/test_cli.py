@@ -1,0 +1,56 @@
+"""CLI command tests (reference: ctl/*_test.go).
+
+Most CLI surface is covered end-to-end elsewhere (import/export/backup in
+test_http.py / test_backup.py; server boot in test_clusterproc.py). Here:
+the introspection commands that only print.
+"""
+
+import io
+import tomllib
+from contextlib import redirect_stdout
+
+from pilosa_tpu.cli import main
+
+
+def _run(argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(argv)
+    return rc, buf.getvalue()
+
+
+def test_generate_config_is_valid_toml():
+    rc, out = _run(["generate-config"])
+    assert rc == 0
+    cfg = tomllib.loads(out)
+    assert cfg["bind"] == "127.0.0.1:10101"
+
+
+def test_config_prints_effective_merge(tmp_path, monkeypatch):
+    """`config` prints the file < env < flags merge the server would run
+    with (reference: cmd/root.go:71-78 + ctl/config.go Run marshals the
+    viper-merged server.Config)."""
+    p = tmp_path / "c.toml"
+    p.write_text('bind = "10.0.0.1:7777"\nmax-op-n = 5\n'
+                 '[[cluster.nodes]]\nhost = "n1:10101"\n')
+    monkeypatch.setenv("PILOSA_TPU_DATA_DIR", "/env/dir")
+    rc, out = _run(["config", "--config", str(p), "--replicas", "3"])
+    assert rc == 0
+    cfg = tomllib.loads(out)
+    assert cfg["bind"] == "10.0.0.1:7777"          # file
+    assert cfg["data-dir"] == "/env/dir"           # env beats default
+    assert cfg["replicas"] == 3                    # flag
+    assert cfg["max-op-n"] == 5
+    assert cfg["cluster"]["nodes"] == [{"host": "n1:10101"}]
+    from pilosa_tpu.shardwidth import EXPONENT
+
+    assert cfg["shard-width-exponent"] == EXPONENT
+
+
+def test_config_flag_beats_file(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text('bind = "10.0.0.1:7777"\n')
+    rc, out = _run(["config", "--config", str(p),
+                    "--bind", "0.0.0.0:1234"])
+    assert rc == 0
+    assert tomllib.loads(out)["bind"] == "0.0.0.0:1234"

@@ -305,3 +305,111 @@ def test_export_csv_translates_keys(tmp_path):
         assert lines == ["blue,c2", "red,c1"]
     finally:
         h.close()
+
+
+def test_fragment_nodes_route(srv):
+    """GET /internal/fragment/nodes resolves a shard's owner nodes — the
+    path a stock internal client uses for placement (reference:
+    http/handler.go:311 handleGetFragmentNodes)."""
+    c = srv.client
+    c.create_index("fn")
+    c.create_field("fn", "f", {"type": "set"})
+    nodes = c._request("GET", "/internal/fragment/nodes?index=fn&shard=0")
+    assert isinstance(nodes, list) and len(nodes) == 1
+    assert "id" in nodes[0]
+    # non-integer shard -> 400, matching the reference's explicit check
+    from pilosa_tpu.server.client import ClientError
+
+    with pytest.raises(ClientError) as e:
+        c._request("GET", "/internal/fragment/nodes?index=fn&shard=x")
+    assert e.value.status == 400
+
+
+def test_delete_remote_available_shard():
+    """DELETE .../remote-available-shards/{shard} forgets a peer's stale
+    shard advertisement (reference: http/handler.go:316 ->
+    api.DeleteAvailableShard -> Field.RemoveAvailableShard field.go:513)."""
+    from tests.harness import ClusterHarness
+
+    cl = ClusterHarness(2)
+    try:
+        h = cl[0]
+        h.client.create_index("ras")
+        h.client.create_field("ras", "f", {"type": "set"})
+        peer = cl[1].cluster.local_id
+        h.cluster.record_remote_shards(peer, "ras", {3, 7})
+        assert h.cluster.remote_available_shards("ras") == {3, 7}
+        out = h.client._request(
+            "DELETE", "/internal/index/ras/field/f"
+                      "/remote-available-shards/3")
+        assert out == {"success": True}
+        assert h.cluster.remote_available_shards("ras") == {7}
+        # unknown field -> 404
+        from pilosa_tpu.server.client import ClientError
+
+        with pytest.raises(ClientError) as e:
+            h.client._request(
+                "DELETE", "/internal/index/ras/field/nope"
+                          "/remote-available-shards/3")
+        assert e.value.status == 404
+    finally:
+        cl.close()
+
+
+def test_cors_allowed_origins():
+    """CORS headers appear only when the handler is configured with
+    allowed origins and the request Origin matches (reference:
+    http/handler.go:83-91 OptHandlerAllowedOrigins)."""
+    import urllib.request
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server import API, PilosaHTTPServer
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="pilosa_tpu_cors_")
+    holder = Holder(tmp, use_snapshot_queue=False).open()
+    server = PilosaHTTPServer(
+        API(holder), host="127.0.0.1", port=0,
+        allowed_origins=["http://example.com"]).start()
+    try:
+        def get(origin=None, method="GET"):
+            req = urllib.request.Request(
+                server.address + "/version", method=method)
+            if origin:
+                req.add_header("Origin", origin)
+            try:
+                resp = urllib.request.urlopen(req, timeout=5)
+                return resp.status, resp.headers
+            except urllib.error.HTTPError as e:
+                return e.code, e.headers
+
+        # matching origin -> echoed back
+        _, headers = get("http://example.com")
+        assert headers.get("Access-Control-Allow-Origin") \
+            == "http://example.com"
+        # non-matching origin / no origin -> no CORS header
+        _, headers = get("http://evil.example")
+        assert headers.get("Access-Control-Allow-Origin") is None
+        _, headers = get(None)
+        assert headers.get("Access-Control-Allow-Origin") is None
+        # preflight
+        status, headers = get("http://example.com", method="OPTIONS")
+        assert status == 200
+        assert "POST" in headers.get("Access-Control-Allow-Methods", "")
+        assert headers.get("Access-Control-Allow-Headers") == "Content-Type"
+        status, _ = get("http://evil.example", method="OPTIONS")
+        assert status == 403
+    finally:
+        server.stop()
+        holder.close()
+
+
+def test_cors_disabled_by_default(srv):
+    """Without the option no CORS header is emitted, matching the
+    reference's unwrapped router."""
+    import urllib.request
+
+    req = urllib.request.Request(srv.address + "/version")
+    req.add_header("Origin", "http://example.com")
+    resp = urllib.request.urlopen(req, timeout=5)
+    assert resp.headers.get("Access-Control-Allow-Origin") is None
