@@ -446,11 +446,51 @@ def bench_served_1b():
         res["served_qps"], cpu_qps, res)
 
 
+def bench_golden_cluster():
+    """BASELINE config 5 analog (CPU-labeled): the golden black-box PQL
+    suite (tests/testdata/golden_pql.json, ported from the reference's
+    executor_test.go) against a REAL 3-process cluster over HTTP,
+    queries spread across all nodes. Real multi-chip isn't available in
+    this environment, so this is explicitly the multi-process CPU
+    equivalent of the reference's 4-node full-suite run; correctness of
+    the same run is asserted by tests/test_golden_cluster.py."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, ".")
+    tgc = importlib.import_module("tests.test_golden_cluster")
+    setup, cases = tgc.load_golden()
+    cluster = importlib.import_module(
+        "tests.test_clusterproc").ProcCluster(3, replicas=2)
+    try:
+        cluster.wait_ready()
+        tgc._create_schema(cluster.clients[0])
+        time.sleep(1.0)
+        tgc._apply_setup(cluster.clients[0], setup)
+
+        def run_all():
+            tgc._run_cases(cluster.clients, cases)
+
+        run_all()  # warm + correctness
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_all()
+        qps = reps * len(cases) / (time.perf_counter() - t0)
+    finally:
+        cluster.close()
+    _emit("golden_cluster_suite_qps", qps, None, {
+        "platform": "cpu-cluster(3proc)", "n_cases": len(cases),
+        "note": "config-5 analog: multi-process CPU cluster, "
+                "multi-chip unavailable in this environment"})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
     "bsi_range_sum": bench_bsi_range_sum,
     "served_1b": bench_served_1b,
+    "golden_cluster": bench_golden_cluster,
 }
 
 

@@ -89,6 +89,10 @@ class ResizeManager:
         self.job = None  # coordinator: current ResizeJob
         self._lock = threading.RLock()
         self.on_complete = None  # test hook
+        # Fired on EVERY local RESIZING->NORMAL transition (finalize,
+        # revert/abort, follower CLUSTER_STATUS): the API drains its
+        # queued-while-resizing writes here.
+        self.on_state_normal = None
 
     # ---------------------------------------------------------- coordinator
 
@@ -175,6 +179,8 @@ class ResizeManager:
         self.cluster.invalidate_shard_map()
         self._broadcast_status(CLUSTER_STATE_NORMAL, job.old_nodes,
                                targets=job.old_nodes + job.new_nodes)
+        if self.on_state_normal:
+            self.on_state_normal()
 
     def _cluster_shards(self, index_name, old_nodes):
         """Union of available shards across every old node — the
@@ -258,6 +264,8 @@ class ResizeManager:
         # DONE only after peers were told NORMAL: a client that polls
         # status DONE must not then hit a follower still rejecting queries
         job.state = "DONE"
+        if self.on_state_normal:
+            self.on_state_normal()
         if self.on_complete:
             self.on_complete(job)
 
@@ -371,6 +379,8 @@ class ResizeManager:
             self.cluster.invalidate_shard_map()
             if state == CLUSTER_STATE_NORMAL and nodes:
                 clean_holder(self.holder, self.cluster)
+            if state == CLUSTER_STATE_NORMAL and self.on_state_normal:
+                self.on_state_normal()
             return True
         if msg_type == MessageType.SET_COORDINATOR:
             with self._lock:

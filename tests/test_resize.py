@@ -239,3 +239,142 @@ def test_clean_holder_unit(tmp_path):
 def _local_fragment_shards_holder(holder, index, field):
     view = holder.index(index).field(field).view()
     return set(view.fragments) if view else set()
+
+
+# ---------------------------------------------------------------------------
+# writes during resize (reference: the reference REJECTS imports while
+# RESIZING — api.go:101 methodsResizing admits only fragmentData/abort;
+# our policy upgrades that to queue-and-replay on the RESIZING->NORMAL
+# transition, so a client import racing a resize loses nothing whether
+# the resize completes or aborts. Policy documented in PARITY.md.)
+# ---------------------------------------------------------------------------
+
+def _slow_stream(mgr, release):
+    """Make `mgr`'s fragment streaming block until `release` is set, so
+    tests get a deterministic RESIZING window."""
+    orig = mgr._retrieve_shard
+
+    def slowed(src):
+        release.wait(timeout=30)
+        return orig(src)
+
+    mgr._retrieve_shard = slowed
+
+
+def test_import_during_resize_lands_after_completion(rcluster):
+    import threading
+
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("wr")
+    a.api.create_field("wr", "f")
+    base_cols = list(range(0, 6 * SHARD_WIDTH, 100_003))
+    a.api.import_bits("wr", "f", [0] * len(base_cols), base_cols)
+
+    release = threading.Event()
+    _slow_stream(new.api.resize, release)
+    a.client.resize_add_node(make_node(new).id, new.address)
+    assert a.cluster.state == "RESIZING"
+
+    # the import arrives mid-resize: accepted (queued), not rejected
+    extra_cols = [c0 + 1 for c0 in base_cols]
+    got = a.api.import_bits("wr", "f", [0] * len(extra_cols), extra_cols)
+    assert got == 0  # queued, not yet applied
+
+    release.set()
+    assert wait_until(
+        lambda: a.client.resize_status()["job"]["state"] == "DONE")
+    want = len(base_cols) + len(extra_cols)
+    # drain is async: wait for the replay to land, then check every node
+    assert wait_until(
+        lambda: a.client.query("wr", "Count(Row(f=0))")["results"][0]
+        == want), "queued import lost after resize completion"
+    for h in (b, new):
+        assert h.client.query("wr", "Count(Row(f=0))")["results"][0] == want
+
+
+def test_import_during_resize_lands_after_abort(rcluster):
+    import threading
+
+    c = rcluster
+    a, b, new = c.all
+    a.api.create_index("wa")
+    a.api.create_field("wa", "f")
+    base_cols = list(range(0, 4 * SHARD_WIDTH, 99_991))
+    a.api.import_bits("wa", "f", [0] * len(base_cols), base_cols)
+
+    release = threading.Event()
+    _slow_stream(new.api.resize, release)
+    a.client.resize_add_node(make_node(new).id, new.address)
+    assert a.cluster.state == "RESIZING"
+
+    extra_cols = [c0 + 2 for c0 in base_cols]
+    assert a.api.import_bits("wa", "f", [0] * len(extra_cols),
+                             extra_cols) == 0
+
+    a.api.resize.abort()
+    release.set()
+    assert a.cluster.state == "NORMAL"
+    assert len(a.cluster.nodes) == 2  # old topology restored
+    want = len(base_cols) + len(extra_cols)
+    assert wait_until(
+        lambda: a.client.query("wa", "Count(Row(f=0))")["results"][0]
+        == want), "queued import lost after resize abort"
+
+
+def test_resize_write_queue_backpressure(rcluster):
+    from pilosa_tpu.server import ApiError
+
+    c = rcluster
+    a = c.all[0]
+    a.api.create_index("wq")
+    a.api.create_field("wq", "f")
+    a.api.RESIZE_QUEUE_MAX = 2  # instance override
+    a.cluster.state = "RESIZING"
+    try:
+        assert a.api.import_bits("wq", "f", [0], [1]) == 0
+        assert a.api.import_bits("wq", "f", [0], [2]) == 0
+        with pytest.raises(ApiError, match="queue full"):
+            a.api.import_bits("wq", "f", [0], [3])
+    finally:
+        a.cluster.state = "NORMAL"
+        a.api._drain_resize_writes()
+    assert wait_until(
+        lambda: a.client.query("wq", "Count(Row(f=0))")["results"][0] == 2)
+
+
+def test_remote_import_slices_rejected_while_resizing(rcluster):
+    """Internal fan-out hops (remote=True) must NOT be queued: replay
+    would apply them locally on a node the resize may have de-ownered.
+    They get the reference's RESIZING rejection; the coordinating node's
+    degraded-write policy owns the failure."""
+    from pilosa_tpu.server import ApiError
+
+    c = rcluster
+    a = c.all[0]
+    a.api.create_index("wrr")
+    a.api.create_field("wrr", "f")
+    a.cluster.state = "RESIZING"
+    try:
+        with pytest.raises(ApiError, match="resizing"):
+            a.api.import_bits("wrr", "f", [0], [1], remote=True)
+    finally:
+        a.cluster.state = "NORMAL"
+
+
+def test_doomed_import_404s_even_while_resizing(rcluster):
+    """Validation precedes queueing: an import that can never succeed
+    must fail NOW, not vanish into a replay-time log line."""
+    from pilosa_tpu.server import NotFoundError
+
+    c = rcluster
+    a = c.all[0]
+    a.api.create_index("wv")
+    a.cluster.state = "RESIZING"
+    try:
+        with pytest.raises(NotFoundError):
+            a.api.import_bits("wv", "no_such_field", [0], [1])
+        with pytest.raises(NotFoundError):
+            a.api.import_values("no_such_index", "f", [1], [5])
+    finally:
+        a.cluster.state = "NORMAL"
