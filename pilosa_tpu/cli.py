@@ -301,6 +301,22 @@ def cmd_server(args):
 
         _threading.Thread(target=_join, daemon=True,
                           name="cluster-join").start()
+    if server.tls_cert:
+        # SIGHUP rotates the TLS keypair without a restart (reference:
+        # keypairReloader server/tlsconfig.go:68-90 installs the same
+        # signal hook); a bad new keypair keeps the old one serving.
+        import signal as _signal
+
+        def _reload_tls(signum, frame):
+            try:
+                server.reload_tls()
+                print("SIGHUP: reloaded TLS certificate and key",
+                      flush=True)
+            except Exception as e:
+                print(f"SIGHUP: keeping old TLS keypair "
+                      f"(reload failed: {e})", flush=True)
+
+        _signal.signal(_signal.SIGHUP, _reload_tls)
     extra = f", cluster of {len(cluster.nodes)}" if cluster else ""
     print(f"pilosa_tpu server listening on {server.address} "
           f"(data: {data_dir}{extra})", flush=True)

@@ -46,6 +46,7 @@ class PilosaHTTPServer:
         self.routes = self._build_routes()
         self._httpd = None
         self._thread = None
+        self._tls_ctx = None
 
     # -- route table (reference: http/handler.go:273-322) --------------------
 
@@ -485,6 +486,8 @@ class PilosaHTTPServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(self.tls_cert, self.tls_key)
+            self._tls_ctx = ctx
+            self._stash_keypair()
             # Defer the handshake to the per-connection worker thread
             # (first read); a handshake in accept() would let one stalled
             # client block ALL new connections.
@@ -496,6 +499,55 @@ class PilosaHTTPServer:
             target=self._httpd.serve_forever, name="pilosa-http", daemon=True)
         self._thread.start()
         return self
+
+    def reload_tls(self):
+        """Re-read the certificate/key files into the live TLS context:
+        new handshakes serve the new keypair, existing connections are
+        untouched (reference: keypairReloader server/tlsconfig.go:68-90,
+        which reloads on SIGHUP so operators can rotate certs without a
+        restart; the CLI wires SIGHUP to this method). Raises on a bad
+        keypair, keeping the old one serving — same policy as the
+        reference's maybeReload.
+
+        load_cert_chain mutates the context in stages (cert chain, then
+        key, then pair check), so a half-bad rotation could strand the
+        LIVE context with new-cert/old-key. Guard rails: validate the
+        files in a scratch context first, and if the live load still
+        fails (filesystem race between the two loads), restore the
+        stashed last-good PEMs into the live context."""
+        if not self.tls_cert or self._tls_ctx is None:
+            raise RuntimeError("TLS not enabled on this server")
+        import ssl
+
+        scratch = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        scratch.load_cert_chain(self.tls_cert, self.tls_key)
+        try:
+            self._tls_ctx.load_cert_chain(self.tls_cert, self.tls_key)
+        except Exception:
+            self._restore_last_good_keypair()
+            raise
+        self._stash_keypair()
+
+    def _stash_keypair(self):
+        with open(self.tls_cert, "rb") as f:
+            cert_pem = f.read()
+        with open(self.tls_key, "rb") as f:
+            key_pem = f.read()
+        self._tls_last_good = (cert_pem, key_pem)
+
+    def _restore_last_good_keypair(self):
+        import tempfile
+
+        if not getattr(self, "_tls_last_good", None):
+            return
+        cert_pem, key_pem = self._tls_last_good
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                tempfile.NamedTemporaryFile(suffix=".key") as kf:
+            cf.write(cert_pem)
+            cf.flush()
+            kf.write(key_pem)
+            kf.flush()
+            self._tls_ctx.load_cert_chain(cf.name, kf.name)
 
     def stop(self):
         if self._httpd:

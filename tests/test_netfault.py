@@ -1,0 +1,284 @@
+"""Real-network fault injection (reference: internal/clustertests runs a
+docker-compose cluster and uses pumba to PAUSE a container's network mid-
+import — cluster_test.go:68-78, docker-compose.yml:1-57).
+
+No containers here, so the network fault is injected one layer down: all
+inter-node AND client traffic rides per-node userspace TCP proxies, and
+"partitioning" a node means its proxy accepts connections but forwards
+nothing — packets effectively blackholed while the server process stays
+ALIVE (unlike test_clusterproc.py's SIGSTOP, which freezes the process
+itself). This exercises the paths a real partition does: client/probe
+timeouts against hung sockets, confirm-down marking, degraded reads via
+live replicas, and anti-entropy convergence after the partition heals.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PILOSA_TPU_PROC_TESTS", "1") == "0",
+    reason="process cluster tests disabled")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class PausableProxy:
+    """TCP forwarder 127.0.0.1:listen_port -> 127.0.0.1:backend_port.
+    pause(): existing pipes stall and new connections are accepted but
+    never serviced — the userspace analog of pumba's packet pause."""
+
+    def __init__(self, listen_port, backend_port):
+        self.backend_port = backend_port
+        self.paused = threading.Event()
+        self._stop = threading.Event()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", listen_port))
+        self._srv.listen(64)
+        self._srv.settimeout(0.2)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="netfault-proxy")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._pipe_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _pipe_conn(self, conn):
+        try:
+            if self.paused.is_set():
+                # hold the socket open, forward nothing: the far side's
+                # request hangs exactly like a blackholed link
+                while self.paused.is_set() and not self._stop.is_set():
+                    time.sleep(0.1)
+                conn.close()
+                return
+            back = socket.create_connection(
+                ("127.0.0.1", self.backend_port), timeout=5)
+        except OSError:
+            conn.close()
+            return
+
+        def pump(src, dst):
+            try:
+                while not self._stop.is_set():
+                    if self.paused.is_set():
+                        time.sleep(0.1)  # stall mid-stream
+                        continue
+                    src.settimeout(0.2)
+                    try:
+                        data = src.recv(65536)
+                    except socket.timeout:
+                        continue
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+        threading.Thread(target=pump, args=(conn, back),
+                         daemon=True).start()
+        threading.Thread(target=pump, args=(back, conn),
+                         daemon=True).start()
+
+    def pause(self):
+        self.paused.set()
+
+    def resume(self):
+        self.paused.clear()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class ProxiedCluster:
+    """3 real server processes whose cluster identity is their PROXY
+    address: every inter-node hop rides a PausableProxy, so pausing one
+    proxy network-partitions that node while its process stays alive."""
+
+    def __init__(self, n=3, replicas=2, anti_entropy="2s"):
+        # everything spawned so far must die if construction fails
+        # mid-way, else server processes outlive the test run
+        self.proxies, self.dirs, self.procs, self.logs = [], [], [], []
+        try:
+            self._boot(n, replicas, anti_entropy)
+        except BaseException:
+            self.close()
+            raise
+
+    def _boot(self, n, replicas, anti_entropy):
+        ports = _free_ports(2 * n)
+        self.real_ports, self.proxy_ports = ports[:n], ports[n:]
+        hosts = ",".join(f"127.0.0.1:{p}" for p in self.proxy_ports)
+        for pp, rp in zip(self.proxy_ports, self.real_ports):
+            self.proxies.append(PausableProxy(pp, rp))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for i in range(n):
+            self.dirs.append(tempfile.mkdtemp(prefix="pilosa-netfault-"))
+            cfg = os.path.join(self.dirs[i], "config.toml")
+            with open(cfg, "w") as f:
+                f.write(f'anti-entropy = {{ interval = "{anti_entropy}" }}\n')
+            log = open(os.path.join(self.dirs[i], "server.log"), "w")
+            self.logs.append(log)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                 "--bind", f"127.0.0.1:{self.real_ports[i]}",
+                 "--node-id", f"127.0.0.1:{self.proxy_ports[i]}",
+                 "--data-dir", self.dirs[i],
+                 "--cluster-hosts", hosts,
+                 "--replicas", str(replicas),
+                 "--config", cfg],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+        # clients also ride the proxies: a paused node is unreachable to
+        # its clients too, like a real partition
+        self.clients = [Client(f"http://127.0.0.1:{p}", timeout=30)
+                        for p in self.proxy_ports]
+
+    def wait_ready(self, timeout=90):
+        deadline = time.time() + timeout
+        pending = set(range(len(self.procs)))
+        while pending and time.time() < deadline:
+            for i in list(pending):
+                if self.procs[i].poll() is not None:
+                    raise RuntimeError(f"node {i} exited: " + self._tail(i))
+                try:
+                    self.clients[i]._request("GET", "/status")
+                    pending.discard(i)
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        if pending:
+            raise TimeoutError(f"nodes {sorted(pending)} not ready: "
+                               + "; ".join(self._tail(i) for i in pending))
+
+    def _tail(self, i):
+        self.logs[i].flush()
+        with open(self.logs[i].name) as f:
+            return f.read()[-2000:]
+
+    def node_states(self, via):
+        status = self.clients[via]._request("GET", "/status")
+        return {n["id"]: n.get("state")
+                for n in status.get("nodes", [])}
+
+    def close(self):
+        for p in self.procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self.logs:
+            log.close()
+        for proxy in self.proxies:
+            proxy.close()
+        import shutil
+
+        for d in self.dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def wait_until(fn, timeout=45.0, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if fn():
+                return True
+        except Exception:
+            pass
+        time.sleep(interval)
+    return False
+
+
+def test_partition_degraded_reads_and_heal():
+    """The pumba scenario end-to-end: import across shards, blackhole one
+    node's network, assert live nodes (1) mark it DOWN via hung probes,
+    (2) keep answering with replica routing; heal, assert anti-entropy
+    re-converges and the node serves again."""
+    c = ProxiedCluster(3, replicas=2)
+    try:
+        c.wait_ready()
+        c.clients[0].create_index("nf")
+        c.clients[0].create_field("nf", "f", {"type": "set"})
+        time.sleep(1.0)
+        cols = list(range(0, 6 * SHARD_WIDTH, 50_021))
+        c.clients[0].import_bits("nf", "f", [0] * len(cols), cols)
+        want = len(cols)
+        assert wait_until(lambda: c.clients[0].query(
+            "nf", "Count(Row(f=0))")["results"][0] == want)
+
+        victim = 2
+        victim_id = f"127.0.0.1:{c.proxy_ports[victim]}"
+        c.proxies[victim].pause()
+
+        # hung (not refused) probes must still confirm DOWN
+        assert wait_until(
+            lambda: c.node_states(0).get(victim_id) == "DOWN",
+            timeout=60), "partitioned node never marked DOWN"
+
+        # degraded reads: live nodes answer the full count via replicas
+        for i in (0, 1):
+            got = c.clients[i].query("nf", "Count(Row(f=0))")["results"][0]
+            assert got == want, f"degraded read via node {i}: {got}"
+
+        # writes during the partition land on live replicas
+        extra = [c0 + 1 for c0 in cols]
+        c.clients[0].import_bits("nf", "f", [0] * len(extra), extra)
+        want2 = want + len(extra)
+        assert wait_until(lambda: c.clients[0].query(
+            "nf", "Count(Row(f=0))")["results"][0] == want2)
+
+        # heal: node returns READY and serves the converged data
+        c.proxies[victim].resume()
+        assert wait_until(
+            lambda: c.node_states(0).get(victim_id) != "DOWN",
+            timeout=60), "healed node never recovered"
+        assert wait_until(
+            lambda: c.clients[victim].query(
+                "nf", "Count(Row(f=0))")["results"][0] == want2,
+            timeout=60), "anti-entropy did not reconverge healed node"
+    finally:
+        c.close()

@@ -16,16 +16,20 @@ pytestmark = pytest.mark.skipif(
     shutil.which("openssl") is None, reason="openssl not available")
 
 
-@pytest.fixture
-def certs(tmp_path):
-    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+def _mk_cert(path_prefix, cn):
+    cert, key = f"{path_prefix}.pem", f"{path_prefix}.key"
     subprocess.run(
         ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
          "-keyout", key, "-out", cert, "-days", "1",
-         "-subj", "/CN=127.0.0.1",
+         "-subj", f"/CN={cn}",
          "-addext", "subjectAltName=IP:127.0.0.1"],
         check=True, capture_output=True)
     return cert, key
+
+
+@pytest.fixture
+def certs(tmp_path):
+    return _mk_cert(str(tmp_path / "c"), "127.0.0.1")
 
 
 def test_https_end_to_end(tmp_path, certs):
@@ -66,6 +70,74 @@ def test_stalled_client_does_not_block_accept(tmp_path, certs):
                             for i in client.schema()["indexes"]}
         finally:
             stalled.close()
+    finally:
+        srv.stop()
+        holder.close()
+
+
+def _served_cn(address):
+    import ssl
+    import urllib.parse
+
+    host, port = urllib.parse.urlparse(address).netloc.split(":")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    import socket
+
+    with socket.create_connection((host, int(port)), timeout=5) as sock:
+        with ctx.wrap_socket(sock, server_hostname=host) as tls:
+            der = tls.getpeercert(binary_form=True)
+    # pull CN out of the DER without a cert parser: openssl x509 -noout
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".der") as f:
+        f.write(der)
+        f.flush()
+        out = subprocess.run(
+            ["openssl", "x509", "-inform", "der", "-in", f.name,
+             "-noout", "-subject"],
+            check=True, capture_output=True, text=True).stdout
+    return out.strip()
+
+
+def test_sighup_style_keypair_reload(tmp_path):
+    """reload_tls() re-reads the cert/key files in place: new handshakes
+    serve the rotated keypair without a restart; a broken keypair is
+    rejected and the old one keeps serving (reference: keypairReloader
+    server/tlsconfig.go:68-90 + maybeReload)."""
+    cert, key = _mk_cert(str(tmp_path / "old"), "old.example")
+    holder = Holder(str(tmp_path / "data")).open()
+    srv = PilosaHTTPServer(API(holder), host="127.0.0.1", port=0,
+                           tls_cert=cert, tls_key=key).start()
+    try:
+        assert "old.example" in _served_cn(srv.address)
+
+        # rotate: overwrite the SAME paths, reload, new CN served
+        new_cert, new_key = _mk_cert(str(tmp_path / "new"), "new.example")
+        import shutil as _sh
+
+        _sh.copy(new_cert, cert)
+        _sh.copy(new_key, key)
+        srv.reload_tls()
+        assert "new.example" in _served_cn(srv.address)
+
+        # broken rotations: reload raises, old (new.example) keeps
+        # serving. The KEY failure is the dangerous stage — a naive
+        # load_cert_chain on the live context installs the new cert
+        # before discovering the key mismatch, stranding the context
+        # half-rotated and failing EVERY later handshake.
+        third_cert, _ = _mk_cert(str(tmp_path / "third"), "third.example")
+        _sh.copy(third_cert, cert)  # cert rotated, key NOT -> mismatch
+        with pytest.raises(Exception):
+            srv.reload_tls()
+        assert "new.example" in _served_cn(srv.address)
+        # and the plain bad-cert failure
+        with open(cert, "w") as f:
+            f.write("not a pem")
+        with pytest.raises(Exception):
+            srv.reload_tls()
+        assert "new.example" in _served_cn(srv.address)
     finally:
         srv.stop()
         holder.close()
