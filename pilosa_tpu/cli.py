@@ -1,7 +1,8 @@
 """Command-line interface (reference: cmd/ + ctl/ — cobra commands).
 
 Subcommands mirror the reference CLI (cmd/root.go:71-78): server, import,
-export, inspect, check, generate-config. Config comes from TOML file,
+backup, restore, export, inspect, check, generate-config, and config
+(prints the EFFECTIVE merged configuration). Config comes from TOML file,
 PILOSA_TPU_* env vars, and flags (reference: server/config.go precedence).
 """
 
