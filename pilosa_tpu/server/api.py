@@ -993,6 +993,31 @@ class API:
         return {"attrs": {str(id): attrs for id, attrs
                           in store.block_data(int(block)).items()}}
 
+    def attr_diff(self, index_name, field_name, remote_blocks):
+        """Attrs from every local block that differs from (or is absent
+        in) the caller's checksum list — one round trip of the attr
+        anti-entropy protocol (reference: api.IndexAttrDiff api.go:817 +
+        attrBlocks.Diff attr.go:90; served at
+        /internal/index/{i}/attr/diff and .../field/{f}/attr/diff, which
+        a stock internal client posts to)."""
+        from ..storage.attrs import ATTR_BLOCK_SIZE, _checksum
+
+        store = self._attr_store(index_name, field_name)  # 404s for us
+        if store is None:
+            return {"attrs": {}}
+        remote = {int(b["id"]): b.get("checksum")
+                  for b in (remote_blocks or [])}
+        # one store scan serves both the checksums and the payload
+        # (blocks() + per-block block_data() would rescan per block)
+        by_block = {}
+        for id, a in store.all_items():
+            by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append((id, a))
+        attrs = {}
+        for bid, items in by_block.items():
+            if remote.get(bid) != _checksum(items):
+                attrs.update((str(id), a) for id, a in items)
+        return {"attrs": attrs}
+
     def hosts(self):
         if self.cluster is not None:
             return self.cluster.nodes_json()

@@ -108,6 +108,12 @@ class PilosaHTTPServer:
                   self._post_translate_keys),
             Route("GET", r"/internal/attr/blocks", self._get_attr_blocks),
             Route("GET", r"/internal/attr/data", self._get_attr_block_data),
+            Route("POST", r"/internal/index/(?P<index>[^/]+)/attr/diff",
+                  self._post_index_attr_diff),
+            Route("POST",
+                  r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
+                  r"/attr/diff",
+                  self._post_field_attr_diff),
             Route("POST", r"/recalculate-caches", self._recalculate_caches),
             Route("POST", r"/cluster/resize/add-node", self._resize_add_node),
             Route("POST", r"/cluster/resize/remove-node",
@@ -371,6 +377,19 @@ class PilosaHTTPServer:
         return self.api.attr_block_data(
             self._q1(req, "index"), self._q1(req, "field", ""),
             int(self._q1(req, "block", "0")))
+
+    def _post_index_attr_diff(self, req):
+        """(reference: handler.go:312 handlePostIndexAttrDiff)"""
+        body = req.json() or {}
+        return self.api.attr_diff(
+            req.params["index"], "", body.get("blocks", []))
+
+    def _post_field_attr_diff(self, req):
+        """(reference: handler.go:315 handlePostFieldAttrDiff)"""
+        body = req.json() or {}
+        return self.api.attr_diff(
+            req.params["index"], req.params["field"],
+            body.get("blocks", []))
 
     def _recalculate_caches(self, req):
         self.api.recalculate_caches()

@@ -413,3 +413,37 @@ def test_cors_disabled_by_default(srv):
     req.add_header("Origin", "http://example.com")
     resp = urllib.request.urlopen(req, timeout=5)
     assert resp.headers.get("Access-Control-Allow-Origin") is None
+
+
+def test_attr_diff_routes(srv):
+    """POST /internal/index/{i}/attr/diff and the field variant return
+    attrs for blocks whose checksums differ from the caller's list — one
+    round of the reference's attr anti-entropy (reference:
+    handler.go:312,315 -> api.IndexAttrDiff api.go:817)."""
+    c = srv.client
+    c.create_index("ad")
+    c.create_field("ad", "f")
+    c.query("ad", 'SetColumnAttrs(7, city="austin")')
+    c.query("ad", 'SetRowAttrs(f, 3, color="red")')
+
+    # empty caller list -> every local block differs -> all attrs
+    out = c._request("POST", "/internal/index/ad/attr/diff",
+                     json.dumps({"blocks": []}).encode())
+    assert out["attrs"]["7"] == {"city": "austin"}
+    out = c._request("POST", "/internal/index/ad/field/f/attr/diff",
+                     json.dumps({"blocks": []}).encode())
+    assert out["attrs"]["3"] == {"color": "red"}
+
+    # caller in sync -> empty diff
+    blocks = c._request("GET", "/internal/attr/blocks?index=ad")["blocks"]
+    out = c._request("POST", "/internal/index/ad/attr/diff",
+                     json.dumps({"blocks": blocks}).encode())
+    assert out["attrs"] == {}
+
+    # unknown index/field -> 404
+    from pilosa_tpu.server.client import ClientError
+
+    with pytest.raises(ClientError) as e:
+        c._request("POST", "/internal/index/nope/attr/diff",
+                   json.dumps({"blocks": []}).encode())
+    assert e.value.status == 404
