@@ -1000,23 +1000,11 @@ class API:
         attrBlocks.Diff attr.go:90; served at
         /internal/index/{i}/attr/diff and .../field/{f}/attr/diff, which
         a stock internal client posts to)."""
-        from ..storage.attrs import ATTR_BLOCK_SIZE, _checksum
-
         store = self._attr_store(index_name, field_name)  # 404s for us
         if store is None:
             return {"attrs": {}}
-        remote = {int(b["id"]): b.get("checksum")
-                  for b in (remote_blocks or [])}
-        # one store scan serves both the checksums and the payload
-        # (blocks() + per-block block_data() would rescan per block)
-        by_block = {}
-        for id, a in store.all_items():
-            by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append((id, a))
-        attrs = {}
-        for bid, items in by_block.items():
-            if remote.get(bid) != _checksum(items):
-                attrs.update((str(id), a) for id, a in items)
-        return {"attrs": attrs}
+        return {"attrs": {str(id): a
+                          for id, a in store.diff(remote_blocks).items()}}
 
     def hosts(self):
         if self.cluster is not None:

@@ -70,13 +70,30 @@ class AttrStore:
     def all_items(self):
         raise NotImplementedError
 
-    def blocks(self):
-        """[(block_id, checksum)] for every non-empty 100-id block."""
+    def _grouped(self):
+        """{block_id: [(id, attrs)]} in one store scan."""
         by_block = {}
         for id, attrs in self.all_items():
             by_block.setdefault(id // ATTR_BLOCK_SIZE, []).append((id, attrs))
-        return sorted(
-            (b, _checksum(items)) for b, items in by_block.items())
+        return by_block
+
+    def blocks(self):
+        """[(block_id, checksum)] for every non-empty 100-id block."""
+        return sorted((b, _checksum(items))
+                      for b, items in self._grouped().items())
+
+    def diff(self, remote_blocks):
+        """{id: attrs} from every local block whose checksum differs from
+        (or is absent in) the caller's [(id, checksum)] dict list — one
+        round of attr anti-entropy, in a single store scan (reference:
+        attrBlocks.Diff attr.go:90 + api.IndexAttrDiff api.go:817)."""
+        remote = {int(b["id"]): b.get("checksum")
+                  for b in (remote_blocks or [])}
+        out = {}
+        for bid, items in self._grouped().items():
+            if remote.get(bid) != _checksum(items):
+                out.update(items)
+        return out
 
     def block_data(self, block_id):
         lo = block_id * ATTR_BLOCK_SIZE
