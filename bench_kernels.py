@@ -46,15 +46,13 @@ def _mk_regime(rng, n_shards, words, kind):
 
 
 def main():
-    import os
-
     import jax
+
+    from pilosa_tpu.cli import _honor_jax_platforms_env
 
     # Site hooks force-select the tunnel platform at interpreter start,
     # overriding JAX_PLATFORMS (same trap as bench.py's child).
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        jax.config.update("jax_platforms", want)
+    _honor_jax_platforms_env()
     import jax.numpy as jnp
 
     from pilosa_tpu.shardwidth import WORDS_PER_ROW
@@ -177,5 +175,73 @@ def main():
     }), flush=True)
 
 
+def bsi_pallas_vs_jnp():
+    """The measurement ops/pallas_kernels.py's PERF STATUS note calls
+    for: fused Pallas BSI range kernel vs the shipped two-program jnp
+    path, same [D=16, WORDS_PER_ROW] inputs, n>=30 dispatches,
+    block_until_ready on the batch. Run on a REAL chip
+    (`python bench_kernels.py bsi-pallas`); prints one JSON line with
+    both ms so the kernel can be promoted to default or retired."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+
+    # both paths are invoked explicitly below — the PILOSA_TPU_PALLAS
+    # opt-in gate is not on this code path, so no env var is needed
+    from pilosa_tpu.ops import bsi, pallas_kernels
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+    device = jax.devices()[0]
+    depth, n = 16, 30
+    rng = np.random.default_rng(5)
+    planes = jnp.asarray(rng.integers(
+        0, 1 << 32, (depth, WORDS_PER_ROW), dtype=np.uint32))
+    sign = jnp.zeros((WORDS_PER_ROW,), jnp.uint32)
+    exists = jnp.asarray(rng.integers(
+        0, 1 << 32, (WORDS_PER_ROW,), dtype=np.uint32))
+    pbits = jnp.asarray(bsi.predicate_bits(12345, depth))
+
+    # inputs as jit ARGUMENTS, not closure constants: closed-over arrays
+    # are compile-time constants XLA may fold, which would time a
+    # precomputed buffer fetch instead of the kernel
+    jnp_fn = jax.jit(lambda p, s, e, pb: bsi._range_lt_jnp(
+        p, s, e, pb, False, True))
+    pallas_fn = jax.jit(lambda p, s, e, pb: pallas_kernels.bsi_range_mask(
+        "lt", p, s, e, pb, False, True))
+
+    args = (planes, sign, exists, pbits)
+    got_a, got_b = np.asarray(jnp_fn(*args)), np.asarray(pallas_fn(*args))
+    assert np.array_equal(got_a, got_b), "pallas/jnp mismatch"
+
+    def measure(fn):
+        fn(*args).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(n)]
+        for o in outs:
+            o.block_until_ready()
+        return (time.perf_counter() - t0) / n * 1000
+
+    jnp_ms = measure(jnp_fn)
+    pallas_ms = measure(pallas_fn)
+    print(json.dumps({
+        "metric": "bsi_range_lt_pallas_vs_jnp",
+        "value": round(jnp_ms / pallas_ms, 3),
+        "unit": "speedup_x",
+        "extra": {
+            "platform": device.platform,
+            "device_kind": getattr(device, "device_kind", ""),
+            "depth": depth, "n_dispatches": n,
+            "jnp_ms": round(jnp_ms, 4),
+            "pallas_ms": round(pallas_ms, 4),
+        },
+    }), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "bsi-pallas":
+        bsi_pallas_vs_jnp()
+    else:
+        main()

@@ -495,6 +495,12 @@ CONFIGS = {
 
 
 def main():
+    # Site hooks force-select the tunnel platform at interpreter start,
+    # overriding JAX_PLATFORMS (same trap as bench.py's child): a suite
+    # explicitly run with JAX_PLATFORMS=cpu must actually get cpu.
+    from pilosa_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
     wanted = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in wanted if n not in CONFIGS]
     if unknown:
