@@ -294,3 +294,11 @@ class Client:
         return self._request(
             "GET", f"/internal/attr/data?index={index}&field={field}"
                    f"&block={block}")
+
+    def attr_diff(self, index, blocks, field=""):
+        """Post local block checksums, receive attrs from every block the
+        peer has that differs (reference: handler.go:312,315)."""
+        path = f"/internal/index/{index}/attr/diff" if not field else \
+            f"/internal/index/{index}/field/{field}/attr/diff"
+        return self._request(
+            "POST", path, json.dumps({"blocks": blocks}).encode())

@@ -229,37 +229,61 @@ class HolderSyncer:
 
     def _sync_attrs(self, store, index_name, field_name=""):
         """Block-diff attr merge with every peer (reference: syncIndex
-        holder.go:975 / syncField holder.go:1021; remote attrs for
-        differing blocks are bulk-merged locally)."""
+        holder.go:975 / syncField holder.go:1021). One POST of our block
+        checksums per peer; the peer answers with attrs from every block
+        that differs (the reference's attr/diff protocol, handler.go:
+        312,315) — one round trip instead of blocks + N block fetches.
+        Peers without the diff route fall back to the pull protocol."""
+        from .client import ClientError
+
         if store is None:
             return
-        local = dict(store.blocks())
+        blocks = [{"id": bid, "checksum": chk}
+                  for bid, chk in store.blocks()]
         for node in self.cluster.peers():
             if self.is_closing():
                 return
             client = self.client_factory(node.uri)
             try:
-                resp = client.attr_blocks(index_name, field_name)
-                remote = {b["id"]: b["checksum"]
-                          for b in resp.get("blocks", [])}
-            except Exception:
-                continue
-            diff = [bid for bid, chk in remote.items()
-                    if local.get(bid) != chk]
-            if not diff:
-                continue
-            merged = {}
-            for bid in sorted(diff):
-                try:
-                    data = client.attr_block_data(
-                        index_name, field_name, bid)
-                except Exception:
+                data = client.attr_diff(index_name, blocks,
+                                        field=field_name)
+            except ClientError as e:
+                if e.status not in (404, 405):
+                    continue  # peer refused; don't retry another way
+                # route absent on the peer: pull protocol
+                data = self._pull_attr_diff(
+                    client, index_name, field_name,
+                    {b["id"]: b["checksum"] for b in blocks})
+                if data is None:
                     continue
-                for id_str, attrs in data.get("attrs", {}).items():
-                    merged[int(id_str)] = attrs
+            except Exception:
+                continue  # unreachable peer: a second request would
+                #           just wait out another timeout
+            merged = {int(id_str): attrs for id_str, attrs
+                      in data.get("attrs", {}).items()}
             if merged:
                 store.set_bulk_attrs(merged)
-                local = dict(store.blocks())
+                blocks = [{"id": bid, "checksum": chk}
+                          for bid, chk in store.blocks()]
+
+    @staticmethod
+    def _pull_attr_diff(client, index_name, field_name, local):
+        """Fallback pull protocol: peer's block list, then each
+        differing block's data."""
+        try:
+            resp = client.attr_blocks(index_name, field_name)
+        except Exception:
+            return None
+        remote = {b["id"]: b["checksum"] for b in resp.get("blocks", [])}
+        attrs = {}
+        for bid in sorted(bid for bid, chk in remote.items()
+                          if local.get(bid) != chk):
+            try:
+                data = client.attr_block_data(index_name, field_name, bid)
+            except Exception:
+                continue
+            attrs.update(data.get("attrs", {}))
+        return {"attrs": attrs}
 
 
 class AntiEntropyMonitor:

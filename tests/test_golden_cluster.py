@@ -53,8 +53,15 @@ def _resolve_pql(pql):
 def load_golden():
     doc = json.loads(GOLDEN.read_text())
     setup = [_resolve_pql(s) for s in doc["setup"]]
-    cases = [(c["name"], _resolve_pql(c["query"]), _resolve(c["want"]))
-             for c in doc["cases"]]
+    cases = []
+    for c in doc["cases"]:
+        # "want" checks results[0]; "want_all" the full results list
+        # (multi-call queries like "Store(...) Row(...)")
+        if "want_all" in c:
+            want, whole = _resolve(c["want_all"]), True
+        else:
+            want, whole = _resolve(c["want"]), False
+        cases.append((c["name"], _resolve_pql(c["query"]), want, whole))
     return setup, cases
 
 
@@ -69,6 +76,7 @@ def _create_schema(client):
     client.create_field("gold", "t",
                         {"type": "time", "timeQuantum": "YMD"})
     client.create_field("gold", "kf", {"type": "set", "keys": True})
+    client.create_field("gold", "w", {"type": "set"})
 
 
 def _apply_setup(client, setup):
@@ -81,9 +89,10 @@ def _apply_setup(client, setup):
 
 def _run_cases(clients, cases):
     failures = []
-    for i, (name, pql, want) in enumerate(cases):
+    for i, (name, pql, want, whole) in enumerate(cases):
         client = clients[i % len(clients)]  # spread across nodes
-        got = client.query("gold", pql)["results"][0]
+        results = client.query("gold", pql)["results"]
+        got = results if whole else results[0]
         if got != want:
             failures.append(f"{name} (via node {i % len(clients)}): "
                             f"{pql}\n  got:  {got}\n  want: {want}")
