@@ -334,11 +334,19 @@ class Field:
                 changed += frag.bulk_import(rows[sel], cols[sel], clear=clear)
         return changed
 
-    def import_values(self, column_ids, values):
-        """Bulk BSI import (reference: Field.importValue field.go:1285)."""
+    def import_values(self, column_ids, values, clear=False):
+        """Bulk BSI import (reference: Field.importValue field.go:1285).
+        clear=True removes the stored value of every listed column (the
+        values are ignored; reference: fragment.importValue's clear arg
+        fragment.go:2205)."""
         from ..shardwidth import SHARD_WIDTH
 
         self._require_int()
+        if clear:
+            changed = 0
+            for col in np.asarray(column_ids, dtype=np.uint64).tolist():
+                changed += bool(self.clear_value(int(col)))
+            return changed
         opts = self.options
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         values = np.asarray(values, dtype=np.int64)

@@ -694,19 +694,24 @@ class API:
         return changed + remote_changed
 
     def import_values(self, index_name, field_name, column_ids, values,
-                      remote=False, column_keys=None):
+                      remote=False, column_keys=None, clear=False):
+        """clear=True removes the listed columns' values (reference:
+        ImportValue with OptImportOptionsClear api.go:1035 ->
+        field.importValue field.go:1285)."""
         field = self._field(index_name, field_name)
         if self._queue_resize_write(
                 "values", dict(index_name=index_name, field_name=field_name,
                                column_ids=column_ids, values=values,
-                               remote=remote, column_keys=column_keys)):
+                               remote=remote, column_keys=column_keys,
+                               clear=clear)):
             return 0
         if column_keys is not None:
             _, column_ids = self._translate_import_keys(
                 index_name, field_name, None, column_keys)
         if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
-            changed = field.import_values(column_ids, values)
-            self.holder.index(index_name).add_existence(column_ids)
+            changed = field.import_values(column_ids, values, clear=clear)
+            if not clear:
+                self.holder.index(index_name).add_existence(column_ids)
             self._broadcast_shards_if_changed(index_name)
             return changed
 
@@ -723,8 +728,10 @@ class API:
             local, remotes = self._route_import(index_name, shard)
             if local:
                 changed += field.import_values(
-                    column_ids[mask], values[mask])
-                self.holder.index(index_name).add_existence(column_ids[mask])
+                    column_ids[mask], values[mask], clear=clear)
+                if not clear:
+                    self.holder.index(index_name).add_existence(
+                        column_ids[mask])
                 covered.add(shard)
             else:
                 remote_only.add(shard)
@@ -733,7 +740,7 @@ class API:
                     lambda n=node, c=column_ids[mask], v=values[mask]:
                     self.client_factory(n.uri).import_values(
                         index_name, field_name, c.tolist(), v.tolist(),
-                        remote=True))))
+                        remote=True, clear=clear))))
         _, remote_changed = self._fan_out_writes(
             jobs, covered, count_shards=remote_only,
             index_name=index_name)

@@ -144,10 +144,12 @@ class Client:
         return self._request("POST", path, json.dumps(body).encode())
 
     def import_values(self, index, field, column_ids, values, remote=False,
-                      column_keys=None):
+                      column_keys=None, clear=False):
         path = f"/index/{index}/field/{field}/import"
-        if remote:
-            path += "?remote=true"
+        params = [p for p, on in (("remote=true", remote),
+                                  ("clear=true", clear)) if on]
+        if params:
+            path += "?" + "&".join(params)
         body = {"values": [int(v) for v in values]}
         if column_keys is not None:
             body["columnKeys"] = list(column_keys)

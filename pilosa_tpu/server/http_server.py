@@ -224,16 +224,58 @@ class PilosaHTTPServer:
         return out
 
     def _post_import(self, req):
-        body = req.json()
-        if body is None:
-            raise ApiError("import requires a JSON body")
         index, field = req.params["index"], req.params["field"]
         clear = req.query.get("clear", ["false"])[0] == "true"
         remote = req.query.get("remote", ["false"])[0] == "true"
+        if req.content_type.startswith("application/x-protobuf"):
+            # Stock-client wire (reference: handlePostImport
+            # http/handler.go:1076 — protobuf-ONLY there; we accept JSON
+            # too for our internal client). Message chosen by field
+            # type, timestamps are unix NANOseconds (api.go:1010
+            # time.Unix(0, ts)); responds with ImportResponse bytes on
+            # success. Failures return non-proto error bodies with a
+            # non-200 status — matching the reference, whose handler
+            # also http.Error()s plain text and only marshals
+            # ImportResponse on the success path.
+            import datetime as _dt
+
+            from ..encoding import pilosa_pb2 as _pb
+
+            from ..core.field import FIELD_TYPE_INT
+
+            fld = self.api._field(index, field)  # 404 on unknown
+            if fld.type == FIELD_TYPE_INT:
+                msg = _pb.ImportValueRequest()
+                msg.ParseFromString(req.body)
+                self.api.import_values(
+                    index, field, list(msg.ColumnIDs), list(msg.Values),
+                    remote=remote, clear=clear,
+                    column_keys=list(msg.ColumnKeys) or None)
+            else:
+                msg = _pb.ImportRequest()
+                msg.ParseFromString(req.body)
+                timestamps = None
+                if any(msg.Timestamps):
+                    timestamps = [
+                        _dt.datetime.fromtimestamp(
+                            ts / 1e9, _dt.timezone.utc).replace(tzinfo=None)
+                        if ts else None for ts in msg.Timestamps]
+                self.api.import_bits(
+                    index, field, list(msg.RowIDs), list(msg.ColumnIDs),
+                    timestamps=timestamps, clear=clear, remote=remote,
+                    row_keys=list(msg.RowKeys) or None,
+                    column_keys=list(msg.ColumnKeys) or None)
+            return RawResponse(
+                _pb.ImportResponse(Err="").SerializeToString(),
+                "application/x-protobuf")
+        body = req.json()
+        if body is None:
+            raise ApiError("import requires a JSON body")
         if "values" in body:
             changed = self.api.import_values(
                 index, field, body.get("columnIDs", []), body["values"],
-                remote=remote, column_keys=body.get("columnKeys"))
+                remote=remote, clear=clear,
+                column_keys=body.get("columnKeys"))
         else:
             timestamps = body.get("timestamps")
             if timestamps is not None:

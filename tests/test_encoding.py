@@ -118,3 +118,59 @@ def test_column_attr_sets_roundtrip_and_live(tmp_path):
         assert "columnAttrs" not in out
     finally:
         h.close()
+
+
+def test_protobuf_import_wire():
+    """A stock client's protobuf import (reference: handlePostImport
+    http/handler.go:1076 — Content-Type application/x-protobuf,
+    ImportRequest/ImportValueRequest by field type, nanosecond
+    timestamps, ImportResponse back)."""
+    import urllib.request
+
+    from pilosa_tpu.encoding import pilosa_pb2 as pb
+    from tests.harness import ServerHarness
+
+    h = ServerHarness()
+    try:
+        c = h.client
+        c.create_index("pbi")
+        c.create_field("pbi", "f", {"type": "set"})
+        c.create_field("pbi", "t", {"type": "time", "timeQuantum": "YMD"})
+        c.create_field("pbi", "v",
+                       {"type": "int", "min": -10, "max": 1000})
+
+        def post(field, payload):
+            req = urllib.request.Request(
+                h.address + f"/index/pbi/field/{field}/import",
+                data=payload, method="POST")
+            req.add_header("Content-Type", "application/x-protobuf")
+            req.add_header("Accept", "application/x-protobuf")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = pb.ImportResponse()
+                out.ParseFromString(resp.read())
+                return out
+
+        msg = pb.ImportRequest(
+            Index="pbi", Field="f", RowIDs=[1, 1, 2], ColumnIDs=[5, 9, 5])
+        assert post("f", msg.SerializeToString()).Err == ""
+        assert c.query("pbi", "Row(f=1)")["results"][0]["columns"] == [5, 9]
+
+        # time field: nanosecond timestamps (reference api.go:1010)
+        ns = 1_546_300_800_000_000_000  # 2019-01-01T00:00:00Z
+        msg = pb.ImportRequest(
+            Index="pbi", Field="t", RowIDs=[3], ColumnIDs=[7],
+            Timestamps=[ns])
+        assert post("t", msg.SerializeToString()).Err == ""
+        got = c.query(
+            "pbi",
+            "Row(t=3, from=2018-12-01T00:00, to=2019-02-01T00:00)")
+        assert got["results"][0]["columns"] == [7]
+
+        # int field: ImportValueRequest
+        msg = pb.ImportValueRequest(
+            Index="pbi", Field="v", ColumnIDs=[5, 9], Values=[-7, 400])
+        assert post("v", msg.SerializeToString()).Err == ""
+        got = c.query("pbi", "Sum(field=v)")["results"][0]
+        assert got == {"value": 393, "count": 2}
+    finally:
+        h.close()

@@ -447,3 +447,18 @@ def test_attr_diff_routes(srv):
         c._request("POST", "/internal/index/nope/attr/diff",
                    json.dumps({"blocks": []}).encode())
     assert e.value.status == 404
+
+
+def test_import_values_clear(srv):
+    """?clear=true on a value import removes the listed columns' values
+    (reference: ImportValue with OptImportOptionsClear api.go:1035 ->
+    fragment.importValue clear arg fragment.go:2205)."""
+    c = srv.client
+    c.create_index("vc")
+    c.create_field("vc", "v", {"type": "int", "min": -10, "max": 100})
+    c.import_values("vc", "v", [1, 2, 3], [10, 20, 30])
+    assert c.query("vc", "Sum(field=v)")["results"][0] == \
+        {"value": 60, "count": 3}
+    c.import_values("vc", "v", [2], [0], clear=True)
+    assert c.query("vc", "Sum(field=v)")["results"][0] == \
+        {"value": 40, "count": 2}
