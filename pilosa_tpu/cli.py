@@ -678,6 +678,36 @@ def cmd_config(args):
     return 0
 
 
+def cmd_holder(args):
+    """Open the data directory, load everything, shut down (reference:
+    cmd/server.go:33-57 newHolderCmd — 'only useful for diagnostic use':
+    proves the on-disk state loads cleanly and shows what is in it)."""
+    from .core import Holder
+
+    config = _apply_server_flags(load_config(args.config), args)
+    data_dir = os.path.expanduser(config["data-dir"])
+    if not os.path.isdir(data_dir):
+        # a diagnostic must not create (and then bless) a mistyped path
+        print(f"holder: data directory does not exist: {data_dir}",
+              file=sys.stderr)
+        return 1
+    holder = Holder(data_dir).open()
+    try:
+        n_frags = sum(1 for _ in holder._all_fragments())
+        print(f"holder loaded: {data_dir}")
+        print(f"indexes: {len(holder.indexes)}  "
+              f"fields: {sum(len(i.fields) for i in holder.indexes.values())}  "
+              f"fragments: {n_frags}")
+        for idx in sorted(holder.indexes.values(), key=lambda i: i.name):
+            fields = ", ".join(
+                f"{f.name}({f.type})"
+                for f in sorted(idx.fields.values(), key=lambda f: f.name))
+            print(f"  {idx.name}: {fields}")
+    finally:
+        holder.close()
+    return 0
+
+
 def cmd_generate_config(args):
     """(reference: ctl/generate_config.go) Print default TOML config."""
     print('bind = "127.0.0.1:10101"')
@@ -800,6 +830,13 @@ def main(argv=None):
 
     p = sub.add_parser("generate-config", help="print default config TOML")
     p.set_defaults(fn=cmd_generate_config)
+
+    p = sub.add_parser(
+        "holder", help="open the data directory, load it, shut down "
+                       "(diagnostic)")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--config", default=None)
+    p.set_defaults(fn=cmd_holder)
 
     p = sub.add_parser(
         "config", help="print the effective merged config as TOML "

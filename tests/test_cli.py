@@ -54,3 +54,27 @@ def test_config_flag_beats_file(tmp_path):
                     "--bind", "0.0.0.0:1234"])
     assert rc == 0
     assert tomllib.loads(out)["bind"] == "0.0.0.0:1234"
+
+
+def test_holder_command(tmp_path, monkeypatch):
+    """`holder` opens the data dir, loads, prints a summary, shuts down
+    (reference: cmd/server.go:33-57 newHolderCmd diagnostic)."""
+    from pilosa_tpu.core import FieldOptions, Holder
+
+    d = str(tmp_path / "hd")
+    h = Holder(d).open()
+    idx = h.create_index("diag")
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions.int_field(min=0, max=10))
+    idx.field("f").set_bit(1, 2)
+    h.close()
+
+    monkeypatch.delenv("PILOSA_TPU_DATA_DIR", raising=False)
+    rc, out = _run(["holder", "--data-dir", d])
+    assert rc == 0
+    assert "indexes: 1" in out
+    assert "diag: " in out and "f(set)" in out and "v(int)" in out
+
+    # a mistyped path must error, not be silently created and blessed
+    rc, _out = _run(["holder", "--data-dir", str(tmp_path / "typo")])
+    assert rc == 1
