@@ -18,10 +18,15 @@ from .api import ApiError, NotFoundError, field_options_from_json, \
 
 
 class Route:
-    def __init__(self, method, pattern, fn):
+    def __init__(self, method, pattern, fn, args=None):
         self.method = method
         self.regex = re.compile("^" + pattern + "$")
         self.fn = fn
+        # allowed query-string arg names; None = no validation
+        # (reference: queryArgValidator middleware http/handler.go:320 +
+        # the per-route queryValidationSpec table :174-200 — unknown args
+        # 400 instead of being silently ignored)
+        self.args = frozenset(args) if args is not None else None
 
 
 class PilosaHTTPServer:
@@ -62,15 +67,21 @@ class PilosaHTTPServer:
                   self._post_field),
             Route("DELETE", r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
                   self._delete_field),
-            Route("POST", r"/index/(?P<index>[^/]+)/query", self._post_query),
+            Route("POST", r"/index/(?P<index>[^/]+)/query",
+                  self._post_query,
+                  args=("shards", "remote", "columnAttrs",
+                        "excludeRowAttrs", "excludeColumns")),
             Route("POST",
                   r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
-                  self._post_import),
+                  self._post_import,
+                  args=("clear", "remote", "ignoreKeyCheck")),
             Route("POST",
                   r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
                   r"/import-roaring/(?P<shard>[0-9]+)",
-                  self._post_import_roaring),
-            Route("GET", r"/export", self._get_export),
+                  self._post_import_roaring,
+                  args=("view", "clear", "remote")),
+            Route("GET", r"/export", self._get_export,
+                  args=("index", "field", "shard")),
             Route("GET", r"/schema", self._get_schema),
             Route("POST", r"/schema", self._post_schema),
             Route("GET", r"/status", self._get_status),
@@ -98,10 +109,13 @@ class PilosaHTTPServer:
                   r"/remote-available-shards/(?P<shard>[0-9]+)",
                   self._delete_remote_available_shard),
             Route("GET", r"/internal/fragment/blocks",
-                  self._get_fragment_blocks),
+                  self._get_fragment_blocks,
+                  args=("index", "field", "view", "shard")),
             Route("GET", r"/internal/fragment/block/data",
                   self._get_fragment_block_data),
-            Route("GET", r"/internal/fragment/data", self._get_fragment_data),
+            Route("GET", r"/internal/fragment/data",
+                  self._get_fragment_data,
+                  args=("index", "field", "view", "shard")),
             Route("GET", r"/internal/translate/data",
                   self._get_translate_data),
             Route("POST", r"/internal/translate/keys",
@@ -677,6 +691,13 @@ class PilosaHTTPServer:
             m = route.regex.match(path)
             if m is None:
                 continue
+            if route.args is not None:
+                unknown = set(query) - route.args
+                if unknown:
+                    status, payload = 400, {
+                        "error": "invalid query params: "
+                                 + ", ".join(sorted(unknown))}
+                    break
             req = Request(m.groupdict(), query, body,
                           handler.headers.get("Content-Type", ""))
             # Continue a cross-node trace from incoming headers (reference:

@@ -462,3 +462,24 @@ def test_import_values_clear(srv):
     c.import_values("vc", "v", [2], [0], clear=True)
     assert c.query("vc", "Sum(field=v)")["results"][0] == \
         {"value": 40, "count": 2}
+
+
+def test_unknown_query_params_rejected(srv):
+    """Misspelled query-string args 400 instead of being silently
+    ignored (reference: queryArgValidator http/handler.go:320 + the
+    per-route spec table :174-200)."""
+    from pilosa_tpu.server.client import ClientError
+
+    c = srv.client
+    c.create_index("qa")
+    c.create_field("qa", "f")
+    # the classic typo: ?shard= instead of ?shards=
+    with pytest.raises(ClientError) as e:
+        c._request("POST", "/index/qa/query?shard=0", b"Count(Row(f=0))",
+                   content_type="text/plain")
+    assert e.value.status == 400
+    assert "shard" in str(e.value)
+    # correct spellings still work
+    out = c._request("POST", "/index/qa/query?shards=0",
+                     b"Count(Row(f=0))", content_type="text/plain")
+    assert out["results"] == [0]
