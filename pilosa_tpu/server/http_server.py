@@ -307,6 +307,26 @@ class PilosaHTTPServer:
         clear = req.query.get("clear", ["false"])[0] == "true"
         view = req.query.get("view", ["standard"])[0]
         remote = req.query.get("remote", ["false"])[0] == "true"
+        if req.content_type.startswith("application/x-protobuf"):
+            # Stock-client wire (reference: handlePostImportRoaring
+            # http/handler.go — protobuf ImportRoaringRequest with one
+            # blob per view; empty view name means standard. We keep the
+            # raw-bytes + ?view= form for the internal client.)
+            from ..encoding import pilosa_pb2 as _pb
+
+            msg = _pb.ImportRoaringRequest()
+            msg.ParseFromString(req.body)
+            for v in msg.views:
+                # the proto response carries only Err (reference shape);
+                # the change count is JSON-path-only
+                self.api.import_roaring(
+                    req.params["index"], req.params["field"],
+                    int(req.params["shard"]), v.Data,
+                    clear=bool(msg.Clear),
+                    view=v.Name or "standard", remote=remote)
+            return RawResponse(
+                _pb.ImportResponse(Err="").SerializeToString(),
+                "application/x-protobuf")
         changed = self.api.import_roaring(
             req.params["index"], req.params["field"],
             int(req.params["shard"]), req.body, clear=clear, view=view,

@@ -174,3 +174,41 @@ def test_protobuf_import_wire():
         assert got == {"value": 393, "count": 2}
     finally:
         h.close()
+
+
+def test_protobuf_import_roaring_wire():
+    """Stock-client roaring ingest: protobuf ImportRoaringRequest with
+    per-view blobs (reference: handlePostImportRoaring http/handler.go;
+    empty view name = standard, field.go:1378)."""
+    import urllib.request
+
+    from pilosa_tpu.encoding import pilosa_pb2 as pb
+    from pilosa_tpu.roaring import Bitmap, serialize
+    from tests.harness import ServerHarness
+
+    h = ServerHarness()
+    try:
+        c = h.client
+        c.create_index("pbr")
+        c.create_field("pbr", "f", {"type": "set"})
+
+        b = Bitmap()
+        b.add_many([1, 5, 70000])  # row 0 of the shard (cols 1,5,70000)
+        msg = pb.ImportRoaringRequest()
+        v = msg.views.add()
+        v.Name = ""  # empty = standard view
+        v.Data = serialize(b)
+
+        req = urllib.request.Request(
+            h.address + "/index/pbr/field/f/import-roaring/0",
+            data=msg.SerializeToString(), method="POST")
+        req.add_header("Content-Type", "application/x-protobuf")
+        req.add_header("Accept", "application/x-protobuf")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = pb.ImportResponse()
+            out.ParseFromString(resp.read())
+        assert out.Err == ""
+        got = c.query("pbr", "Row(f=0)")["results"][0]["columns"]
+        assert got == [1, 5, 70000]
+    finally:
+        h.close()

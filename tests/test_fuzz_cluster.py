@@ -34,6 +34,15 @@ def build_cluster(seed, replica_n=2):
     rnd = random.Random(seed)
     model = Model()
     cl = ClusterHarness(3, replica_n=replica_n)
+    try:
+        _populate(cl, rnd, model)
+    except BaseException:
+        cl.close()  # a failed build must not leak three live nodes
+        raise
+    return cl, model
+
+
+def _populate(cl, rnd, model):
     c0 = cl[0].client
     c0.create_index("fc")
     c0.create_field("fc", "f", {"type": "set"})
@@ -55,7 +64,6 @@ def build_cluster(seed, replica_n=2):
         node.import_values("fc", "v", cols, vals)
         model.ints.update(zip(cols, vals))
         model.exists.update(cols)
-    return cl, model
 
 
 @pytest.mark.parametrize("seed", [29, 47])
