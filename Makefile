@@ -1,0 +1,42 @@
+# Developer entry points. Everything runs on the virtual 8-device CPU
+# mesh (tests/conftest.py pins JAX_PLATFORMS=cpu); no TPU required.
+
+PY ?= python
+PYTEST_FLAGS ?= -q -m 'not slow' -p no:cacheprovider
+
+# Multi-process suites: real server subprocesses (cluster boot, SPMD mesh,
+# network faults, golden cluster runs). Slower and noisier than the core
+# in-process suites, so they get their own target.
+DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
+	tests/test_netfault.py tests/test_join.py \
+	tests/test_golden_cluster.py tests/test_fuzz_cluster.py \
+	tests/test_shardwidth_matrix.py tests/test_tls.py \
+	tests/test_bench_orchestrator.py
+
+.PHONY: test test-core test-distributed lint bench-cpu
+
+test: test-core test-distributed
+
+test-core:
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
+		$(foreach f,$(DISTRIBUTED),--ignore=$(f))
+
+test-distributed:
+	$(PY) -m pytest $(DISTRIBUTED) $(PYTEST_FLAGS)
+
+# ruff when available; otherwise fall back to a bytecode-compile pass so
+# the target still catches syntax errors on a bare container (the image
+# has no linters baked in and installs are not allowed).
+lint:
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check pilosa_tpu tests bench.py bench_suite.py \
+			bench_kernels.py; \
+	else \
+		echo "ruff not installed; falling back to compileall"; \
+		$(PY) -m compileall -q pilosa_tpu tests bench.py \
+			bench_suite.py bench_kernels.py; \
+	fi
+
+# The north-star benchmark on the CPU fallback scale: one JSON line.
+bench-cpu:
+	JAX_PLATFORMS=cpu $(PY) bench.py

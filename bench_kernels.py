@@ -240,8 +240,85 @@ def bsi_pallas_vs_jnp():
     }), flush=True)
 
 
+def groupby_pairwise():
+    """Recursive vs pairwise GroupBy inner product: R1*R2 per-combination
+    count_intersect dispatches (the executor's old innermost recursion)
+    against the tiled pairwise_counts matrix (one dispatch + one host
+    sync per tile pair). Prints one JSON line with both wall times and
+    both dispatch counts (`python bench_kernels.py groupby-pairwise
+    [n_shards]`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+
+    from pilosa_tpu.ops import bitplane
+    from pilosa_tpu.shardwidth import WORDS_PER_ROW
+
+    device = jax.devices()[0]
+    n_shards = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if device.platform == "cpu":
+        n_shards = min(n_shards, 4)
+    r1, r2 = 16, 12
+
+    rng = np.random.default_rng(7)
+    A = jnp.asarray(rng.integers(
+        0, 1 << 32, (r1, n_shards, WORDS_PER_ROW), dtype=np.uint32))
+    B = jnp.asarray(rng.integers(
+        0, 1 << 32, (r2, n_shards, WORDS_PER_ROW), dtype=np.uint32))
+
+    count = jax.jit(lambda a, b: bitplane.hi_lo(jnp.sum(
+        jax.lax.population_count(a & b).astype(jnp.int32), axis=-1)))
+
+    def recursive():
+        # the pre-pairwise inner loop: one dispatch + one host sync per
+        # (row_a, row_b) combination
+        out = np.zeros((r1, r2), np.int64)
+        for i in range(r1):
+            for j in range(r2):
+                hi, lo = count(A[i], B[j])
+                out[i, j] = bitplane.combine_hi_lo(
+                    np.asarray(hi), np.asarray(lo))
+        return out
+
+    def pairwise():
+        return bitplane.pairwise_counts(A, B)
+
+    got_r, got_p = recursive(), pairwise()  # warm/compile + check
+    assert np.array_equal(got_r, got_p), "recursive/pairwise mismatch"
+
+    def measure(fn):
+        t0 = time.perf_counter()
+        fn()
+        return (time.perf_counter() - t0) * 1000
+
+    rec_ms = measure(recursive)
+    pw_ms = measure(pairwise)
+    tile = bitplane.pairwise_tile(n_shards)
+    pw_dispatches = -(-r1 // tile) * -(-r2 // tile)
+    print(json.dumps({
+        "metric": "groupby_pairwise_vs_recursive",
+        "value": round(rec_ms / pw_ms, 3),
+        "unit": "speedup_x",
+        "extra": {
+            "platform": device.platform,
+            "device_kind": getattr(device, "device_kind", ""),
+            "n_shards": n_shards, "r1": r1, "r2": r2,
+            "recursive_ms": round(rec_ms, 2),
+            "pairwise_ms": round(pw_ms, 2),
+            "recursive_dispatches": r1 * r2,
+            "pairwise_dispatches": pw_dispatches,
+            "tile": tile,
+        },
+    }), flush=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "bsi-pallas":
         bsi_pallas_vs_jnp()
+    elif len(sys.argv) > 1 and sys.argv[1] == "groupby-pairwise":
+        groupby_pairwise()
     else:
         main()

@@ -485,12 +485,93 @@ def bench_golden_cluster():
                 "multi-chip unavailable in this environment"})
 
 
+def bench_groupby_pairwise():
+    """Two-field GroupBy inner product, recursive vs pairwise: the old
+    stacked recursion issued one row_counts round trip per A row (R1
+    dispatches + syncs); the pairwise driver issues ONE fused count
+    matrix per (A-tile, B-tile) pair. Measures both wall times over the
+    same warmed stacks and reads the pairwise_dispatches/pairwise_syncs
+    observability counters off the stacked cache."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    platform, holder, api, ex = _env()
+    n_shards = 8 if platform != "cpu" else 3
+    n_cols = n_shards * SHARD_WIDTH
+    r1, r2 = 12, 10
+    api.create_index("gp")
+    api.create_field("gp", "a")
+    api.create_field("gp", "b")
+    idx = holder.index("gp")
+
+    rng = np.random.default_rng(13)
+    g_cols = rng.choice(n_cols, size=min(200_000, n_cols // 2),
+                        replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, r1, size=len(g_cols)).astype(np.uint64), g_cols)
+    idx.field("b").import_bits(
+        rng.integers(0, r2, size=len(g_cols)).astype(np.uint64), g_cols)
+
+    st = ex._stacked
+    shards = tuple(sorted(idx.available_shards()))
+    a_rows, b_rows = list(range(r1)), list(range(r2))
+
+    def run_recursive():
+        # the pre-pairwise inner product: one row_counts sync per A row
+        tot = {}
+        stack = st.rows_stack(idx, "a", tuple(a_rows), shards)
+        for i, ra in enumerate(a_rows):
+            counts = st.row_counts(idx, "b", b_rows, stack[i], shards)
+            for rb, c in counts.items():
+                if c:
+                    tot[(ra, rb)] = c
+        return tot
+
+    def run_pairwise():
+        return st.pairwise_counts(idx, "a", a_rows, "b", b_rows,
+                                  None, shards)
+
+    got_r, got_p = run_recursive(), run_pairwise()  # warm + check
+    assert got_r == got_p, "recursive/pairwise mismatch"
+
+    n_q = 20 if platform != "cpu" else 5
+    d0 = st.cache_stats()
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        run_recursive()
+    rec_ms = (time.perf_counter() - t0) / n_q * 1000
+    d1 = st.cache_stats()
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        run_pairwise()
+    pw_ms = (time.perf_counter() - t0) / n_q * 1000
+    d2 = st.cache_stats()
+
+    # full executor path for the headline qps (pairwise driver inside)
+    ex.execute("gp", "GroupBy(Rows(a), Rows(b))")
+    qps = _measure_qps(
+        lambda i: ex.execute("gp", "GroupBy(Rows(a), Rows(b))"), n_q)
+    rtt = _dispatch_rtt_ms()
+    _close(holder)
+    _emit("groupby_pairwise_qps", qps, 1000.0 / rec_ms, {
+        "platform": platform, "n_shards": n_shards, "r1": r1, "r2": r2,
+        "recursive_ms": round(rec_ms, 2),
+        "pairwise_ms": round(pw_ms, 2),
+        "recursive_dispatches_per_q":
+            (d1["dispatches"] - d0["dispatches"]) // n_q,
+        "pairwise_dispatches_per_q":
+            (d2["pairwise_dispatches"] - d1["pairwise_dispatches"]) // n_q,
+        "pairwise_syncs_per_q":
+            (d2["pairwise_syncs"] - d1["pairwise_syncs"]) // n_q,
+        "dispatch_rtt_ms": rtt})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
     "bsi_range_sum": bench_bsi_range_sum,
     "served_1b": bench_served_1b,
     "golden_cluster": bench_golden_cluster,
+    "groupby_pairwise": bench_groupby_pairwise,
 }
 
 

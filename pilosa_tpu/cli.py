@@ -40,10 +40,16 @@ DEFAULT_CONFIG = {
 
 def load_config(path=None):
     """TOML file < env < flags (reference: server/config.go)."""
-    import tomllib
-
     config = json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
     if path:
+        try:
+            import tomllib  # 3.11+
+        except ImportError:
+            try:
+                import tomli as tomllib
+            except ImportError:
+                raise SystemExit(
+                    "--config requires tomllib (Python 3.11+) or tomli")
         with open(path, "rb") as f:
             config.update(tomllib.load(f))
     if os.environ.get("PILOSA_TPU_BIND"):

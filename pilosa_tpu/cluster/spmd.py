@@ -670,6 +670,14 @@ class SpmdDataPlane:
                                                FIELD_TYPE_TIME):
                 return None
             fields.append(field)
+        # Call-level `previous` list cursor (one row id per child), same
+        # validation + seeding as the local executor. Validated BEFORE
+        # the collective round: a malformed cursor must not cost a mesh
+        # step just to fall back to HTTP and raise the same error there.
+        from ..exec.executor import groupby_previous
+
+        previous = groupby_previous(call, len(call.children))
+        prev_t = tuple(previous) if previous is not None else None
         filter_call = call.args.get("filter")
         step = self._gate(idx, shards)
         step["kind"] = "groupby"
@@ -708,6 +716,12 @@ class SpmdDataPlane:
             if limit is not None:
                 rows = rows[:int(limit)]
             child_rows.append(rows)
+        # Seed the outermost child from the cursor (its iterator never
+        # wraps); groups at or before the cursor are dropped
+        # lexicographically below.
+        if previous is not None:
+            lo = previous[0] + (1 if len(child_rows) == 1 else 0)
+            child_rows[0] = [r for r in child_rows[0] if r >= lo]
         cells = 1
         for rows in child_rows:
             cells *= len(rows)
@@ -728,7 +742,7 @@ class SpmdDataPlane:
         # local executor's sorted-group order — no re-sort needed
         out = []
         for group, cnt in zip(itertools.product(*child_rows), counts):
-            if cnt > 0:
+            if cnt > 0 and (prev_t is None or group > prev_t):
                 out.append(GroupCount(
                     [FieldRow(f.name, rid)
                      for f, rid in zip(fields, group)], cnt))

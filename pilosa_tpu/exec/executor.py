@@ -95,6 +95,36 @@ _UINT_ARGS_BY_CALL = {
 }
 
 
+def groupby_previous(call, n_children):
+    """Validated GroupBy `previous` list cursor, or None when absent: one
+    non-negative row id per Rows child, naming the last group a prior page
+    returned; results resume lexicographically after it. Per-child
+    validation mirrors the reference (Call.UintSliceArg pql/ast.go +
+    executeGroupBy's per-field check, executor.go:2737-2745) — a length
+    mismatch or a non-uint element errors rather than silently serving
+    the wrong page."""
+    prev = call.args.get("previous")
+    if prev is None:
+        return None
+    if not isinstance(prev, (list, tuple)):
+        raise ExecError(
+            "'previous' argument must be a list of row ids for GroupBy")
+    if len(prev) != n_children:
+        raise ExecError(
+            "'previous' argument must have a value for each GroupBy field")
+    out = []
+    for val in prev:
+        if isinstance(val, bool) or not isinstance(val, int):
+            raise ExecError(
+                f"could not convert {val!r} to an unsigned integer "
+                f"for 'previous'")
+        if val < 0:
+            raise ExecError(
+                f"value for 'previous' must be positive, but got {val}")
+        out.append(val)
+    return out
+
+
 def validate_uint_args(call):
     """Recursive negative-argument rejection for a whole call tree. Runs
     at the COORDINATOR entry (cluster executor, AFTER key translation) as
@@ -104,6 +134,8 @@ def validate_uint_args(call):
     for key in _UINT_ARGS_BY_CALL.get(call.name, ()):
         if key in call.args:
             uint_arg(call, key)
+    if call.name == "GroupBy" and "previous" in call.args:
+        groupby_previous(call, len(call.children))
     for child in call.children:
         validate_uint_args(child)
     filt = call.args.get("filter")
@@ -1000,6 +1032,7 @@ class Executor:
                 raise ExecError("GroupBy children must be Rows() calls")
         limit = uint_arg_or_none(call, "limit")
         offset = uint_arg_or_none(call, "offset")
+        previous = groupby_previous(call, len(call.children))
         filter_call = call.args.get("filter")
         if filter_call is not None:
             if not isinstance(filter_call, Call):
@@ -1015,12 +1048,23 @@ class Executor:
             self._exec_rows(idx, child, shards, opt).rows
             for child in call.children
         ]
+        if previous is not None:
+            # Seed the outermost child's row start (the reference seeks
+            # each row iterator, executor.go:1403-1406; later iterators
+            # cycle back to their full row sets, so only the outermost —
+            # which never wraps — prunes soundly). Groups at or before
+            # the cursor are dropped lexicographically below.
+            lo = previous[0] + (1 if len(child_rows) == 1 else 0)
+            child_rows[0] = [r for r in child_rows[0] if r >= lo]
 
         totals = self._group_by_stacked(
             idx, fields, child_rows, filter_call, shard_list)
         if totals is None:
             totals = self._group_by_per_shard(
                 idx, fields, child_rows, filter_call, shard_list)
+        if previous is not None:
+            prev_t = tuple(previous)
+            totals = {g: c for g, c in totals.items() if g > prev_t}
 
         out = [
             GroupCount(
@@ -1039,13 +1083,16 @@ class Executor:
 
     def _group_by_stacked(self, idx, fields, child_rows, filter_call,
                           shard_list):
-        """Cross-product counts over stacked shard planes: outer levels
-        walk row combinations as [S, W] device intersections, the innermost
-        level batch-counts all its rows per combination prefix — dispatch
-        count is O(combinations · rows/chunk), independent of the shard
-        count (vs. the reference's per-(shard × combination) scans,
-        executor.go:1238). Returns None to fall back (too few shards, or a
-        filter the stacked path can't express)."""
+        """Thin driver over the stacked pairwise kernel: the innermost TWO
+        levels are one tiled cross-product count matrix
+        (StackedEvaluator.pairwise_counts — O(⌈R1/tile⌉·⌈R2/tile⌉) fused
+        dispatches + host syncs, vs one `row_counts` round trip per outer
+        row combination before); outer levels walk row combinations as
+        [S, W] device intersections in chunks; a single-field GroupBy
+        batch-counts its rows directly. Returns None to fall back (too
+        few shards, a filter the stacked path can't express, or a
+        field/view vanishing mid-query — the per-shard path is
+        untouched)."""
         from .stacked import MIN_SHARDS
 
         if len(shard_list) < MIN_SHARDS:
@@ -1054,25 +1101,32 @@ class Executor:
         covered, filt = self._stacked.filter_stack(idx, filter_call, shards)
         if not covered:
             return None
-        totals = {}
-        inner_field = fields[-1]
-        inner_rows = child_rows[-1]
 
+        if len(fields) == 1:
+            counts = self._stacked.row_counts(
+                idx, fields[0].name, child_rows[0], filt, shards)
+            if counts is None:
+                return None
+            return {(r,): c for r, c in counts.items() if c > 0}
+
+        totals = {}
+        a_field, b_field = fields[-2], fields[-1]
+        a_rows, b_rows = child_rows[-2], child_rows[-1]
         chunk_size = self._stacked.row_chunk_size(shards)
 
         def recurse(level, plane, prefix):
             """plane: accumulated [S, W] restriction (None = everything).
             Returns False to abort (stack construction failed; caller
             falls back to the per-shard path)."""
-            if level == len(fields) - 1:
-                counts = self._stacked.row_counts(
-                    idx, inner_field.name, inner_rows, plane, shards)
-                if counts is None:
+            if level == len(fields) - 2:
+                groups = self._stacked.pairwise_counts(
+                    idx, a_field.name, a_rows, b_field.name, b_rows,
+                    plane, shards)
+                if groups is None:
                     return False
-                for r, c in counts.items():
-                    if c > 0:
-                        key = prefix + (r,)
-                        totals[key] = totals.get(key, 0) + c
+                for pair, c in groups.items():
+                    key = prefix + pair
+                    totals[key] = totals.get(key, 0) + c
                 return True
             # Outer-level row planes come from the rows pool in chunks (not
             # the leaf pool: a wide outer field must not evict the hot

@@ -124,6 +124,41 @@ class GroupCommit:
         return entry[1]
 
 
+#: serializes every multi-device launch in this process (see
+#: StackedEvaluator.__init__ for the rendezvous-starvation rationale)
+_DISPATCH_LOCK = threading.Lock()
+
+_SERIAL_EXECUTION = None
+
+
+def _serial_execution():
+    """True when multi-device programs must be held to COMPLETION (not
+    just enqueued) one at a time. The CPU backend runs the per-device
+    executions of a GSPMD program on a shared thread pool, and the
+    in-program cross-shard reduces rendezvous across them — two programs
+    in flight can each hold part of the pool at their rendezvous and
+    starve each other permanently (observed wedging concurrent serving
+    threads on the 8-virtual-device test mesh). Accelerator backends
+    execute streams FIFO per device, so enqueue order alone already
+    prevents interleaving and overlap stays safe (and async)."""
+    global _SERIAL_EXECUTION
+    if _SERIAL_EXECUTION is None:
+        import jax
+
+        _SERIAL_EXECUTION = jax.default_backend() == "cpu"
+    return _SERIAL_EXECUTION
+
+
+def _launch_barrier(out):
+    """Block the locked dispatch until `out` is resident when the
+    backend requires serial execution (see _serial_execution)."""
+    if _serial_execution():
+        import jax
+
+        jax.block_until_ready(out)
+    return out
+
+
 def _device_get_batch(payloads):
     """GroupCommit `process` for plain result fetches: payloads are
     tuples of device values; ONE device_get resolves them all."""
@@ -294,6 +329,18 @@ class StackedEvaluator:
         self._fetch_commit = GroupCommit()
         self._count_commit = GroupCommit()
         self._lock = threading.Lock()
+        # Multi-device dispatches must not interleave: stacks are
+        # mesh-sharded, so every serving program is a GSPMD launch across
+        # all local devices whose cross-shard reduces rendezvous between
+        # the per-device executions. Concurrent serving threads wedge
+        # that rendezvous on backends without per-device FIFO streams,
+        # so each launch holds this lock — for the enqueue everywhere,
+        # and through completion where _serial_execution() says overlap
+        # is unsafe (the CPU thread-pool backend). Result fetches stay
+        # outside it. The lock is PROCESS-wide, not per-evaluator: the
+        # devices are a process-level resource, and the in-process
+        # cluster harness runs several evaluators over the same mesh.
+        self._dispatch_lock = _DISPATCH_LOCK
         self._sharding = _UNSET
         # Kernel-dispatch counter: tests assert serving dispatch counts are
         # independent of the shard count.
@@ -308,6 +355,11 @@ class StackedEvaluator:
         # assert planes_uploaded stays O(changed shards) under writes.
         self.patches = 0
         self.planes_uploaded = 0
+        # Pairwise GroupBy observability: dispatches and host syncs must
+        # stay O(⌈R1/tile⌉·⌈R2/tile⌉) for a two-field cross product —
+        # tests assert these, not wall time (which is noisy on CPU).
+        self.pairwise_dispatches = 0
+        self.pairwise_syncs = 0
 
     def _stack_sharding(self):
         """NamedSharding over all local devices (None on a single device),
@@ -697,7 +749,9 @@ class StackedEvaluator:
             return None
         planes, sign, exists = data
         self.dispatches += 1
-        return apply_bsi_condition(plan, planes, sign, exists)
+        with self._dispatch_lock:
+            return _launch_barrier(
+                apply_bsi_condition(plan, planes, sign, exists))
 
     def time_row_stack(self, idx, key, shards):
         """[S, W] union of one row across the quantum-view cover (the
@@ -727,7 +781,8 @@ class StackedEvaluator:
         # the evaluator's own union fold: one fn-cache, one operator impl
         sig = ("|", tuple(("leaf", i) for i in range(len(stacks))))
         self.dispatches += 1
-        return self._plane_fn(sig, len(stacks))(*stacks)
+        with self._dispatch_lock:
+            return _launch_barrier(self._plane_fn(sig, len(stacks))(*stacks))
 
     def row_chunk_size(self, shards):
         """Rows per [R, S, W] chunk under the CHUNK_BYTES budget."""
@@ -854,7 +909,9 @@ class StackedEvaluator:
                     args.extend(payloads[pos][1])
                 for _ in range(size - len(chunk)):
                     args.extend(payloads[chunk[0]][1])  # pad: repeat q0
-                his, los = fn(*args)
+                with self._dispatch_lock:
+                    his, los = fn(*args)
+                    _launch_barrier((his, los))
                 outs.append((chunk, his, los))
         flat = [a for _, h, l in outs for a in (h, l)]
         vals = jax.device_get(flat)  # ONE transfer for everything
@@ -1031,7 +1088,9 @@ class StackedEvaluator:
             return False, None
         sig, stacks = gathered
         self.dispatches += 1
-        return True, self._plane_fn(sig, len(stacks))(*stacks)
+        with self._dispatch_lock:
+            return True, _launch_barrier(
+                self._plane_fn(sig, len(stacks))(*stacks))
 
     def row_counts(self, idx, field_name, row_ids, filt, shards,
                    view_name=VIEW_STANDARD):
@@ -1058,12 +1117,14 @@ class StackedEvaluator:
             if stack is None:
                 return None
             self.dispatches += 1
-            hi_lo = fn(stack, filt) if filt is not None else fn(stack)
-            if not cache:
-                # Transient chunks: block before building the next one so
-                # peak HBM stays ~CHUNK_BYTES instead of the whole
-                # candidate set queued in flight.
-                jax.block_until_ready(hi_lo)
+            with self._dispatch_lock:
+                hi_lo = fn(stack, filt) if filt is not None else fn(stack)
+                _launch_barrier(hi_lo)
+                if not cache:
+                    # Transient chunks: block before building the next one
+                    # so peak HBM stays ~CHUNK_BYTES instead of the whole
+                    # candidate set queued in flight.
+                    jax.block_until_ready(hi_lo)
             pending.append((chunk, hi_lo))
         # ONE amortized fetch for every chunk's (hi, lo) pair — shared
         # with concurrently-serving queries via the group commit
@@ -1074,6 +1135,60 @@ class StackedEvaluator:
                 totals = combine_hi_lo(vals[2 * k], vals[2 * k + 1])
                 for j, row_id in enumerate(chunk):
                     out[row_id] = int(totals[j])
+        return out
+
+    def pairwise_counts(self, idx, a_field, a_rows, b_field, b_rows, filt,
+                        shards, view_name=VIEW_STANDARD):
+        """{(a_row, b_row): count > 0} of the two-field GroupBy cross
+        product: counts[i, j] = popcount(a_rows[i] & b_rows[j] & filt)
+        summed over `shards`. Both fields' row stacks come from the rows
+        pool ([R, S, W], incrementally patched like any chunk); the
+        [tile, tile] count matrix is ONE fused dispatch and ONE host sync
+        per (A-tile, B-tile) pair — O(⌈R1/tile⌉·⌈R2/tile⌉) round trips
+        total, vs the recursive path's one `row_counts` sync per A row.
+        The sync rides the group commit, so concurrent GroupBys (and any
+        Sum/Min/Max traffic) share round trips. Returns None when a
+        field/view vanished mid-query (caller falls back)."""
+        shards = tuple(shards)
+        out = {}
+        if not a_rows or not b_rows:
+            return out
+        tile = self.row_chunk_size(shards)
+        row_bytes = self._padded_len(shards) * WORDS_PER_ROW * 4
+        cache_a = len(a_rows) * row_bytes <= MAX_ROWS_STACK_BYTES
+        cache_b = len(b_rows) * row_bytes <= MAX_ROWS_STACK_BYTES
+        import jax
+
+        for i in range(0, len(a_rows), tile):
+            a_chunk = tuple(a_rows[i:i + tile])
+            a_stack = self.rows_stack(idx, a_field, a_chunk, shards,
+                                      view_name, cache=cache_a)
+            if a_stack is None:
+                return None
+            for j in range(0, len(b_rows), tile):
+                b_chunk = tuple(b_rows[j:j + tile])
+                b_stack = self.rows_stack(idx, b_field, b_chunk, shards,
+                                          view_name, cache=cache_b)
+                if b_stack is None:
+                    return None
+                self.dispatches += 1
+                self.pairwise_dispatches += 1
+                with self._dispatch_lock:
+                    hi, lo = bitplane.pairwise_counts_hi_lo(
+                        a_stack, b_stack, filt)
+                    _launch_barrier((hi, lo))
+                    if not (cache_a and cache_b):
+                        # Transient tiles: bound peak HBM before the next
+                        # pair (same discipline as row_counts).
+                        jax.block_until_ready((hi, lo))
+                # ONE host sync for the whole [tile, tile] matrix, shared
+                # with concurrent serving traffic via the group commit
+                vals = self._fetch_commit.submit((hi, lo),
+                                                 _device_get_batch)
+                self.pairwise_syncs += 1
+                totals = combine_hi_lo(vals[0], vals[1])
+                for x, y in zip(*np.nonzero(totals)):
+                    out[(a_chunk[x], b_chunk[y])] = int(totals[x, y])
         return out
 
     def try_sum(self, idx, field, filter_call, shards):
@@ -1091,10 +1206,12 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._sum_fn(filt is not None)
         self.dispatches += 1
-        if filt is not None:
-            res = fn(planes, sign, exists, filt)
-        else:
-            res = fn(planes, sign, exists)
+        with self._dispatch_lock:
+            if filt is not None:
+                res = fn(planes, sign, exists, filt)
+            else:
+                res = fn(planes, sign, exists)
+            _launch_barrier(res)
         p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = \
             self._fetch_commit.submit(tuple(res), _device_get_batch)
         pos = combine_hi_lo(p_hi, p_lo)
@@ -1120,10 +1237,12 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._minmax_fn(filt is not None, is_max)
         self.dispatches += 1
-        if filt is not None:
-            res = fn(planes, sign, exists, filt)
-        else:
-            res = fn(planes, sign, exists)
+        with self._dispatch_lock:
+            if filt is not None:
+                res = fn(planes, sign, exists, filt)
+            else:
+                res = fn(planes, sign, exists)
+            _launch_barrier(res)
         # amortized result fetch (group commit, like try_sum)
         empty, use_neg, bits, c_hi, c_lo = \
             self._fetch_commit.submit(tuple(res), _device_get_batch)
@@ -1147,6 +1266,8 @@ class StackedEvaluator:
                 "patches": self.patches,
                 "planes_uploaded": self.planes_uploaded,
                 "dispatches": self.dispatches,
+                "pairwise_dispatches": self.pairwise_dispatches,
+                "pairwise_syncs": self.pairwise_syncs,
                 "group_fetches": self._fetch_commit.batches,
                 "group_fetched_queries": self._fetch_commit.batched,
                 "count_batches": self._count_commit.batches,

@@ -15,7 +15,7 @@ All functions are jitted and shape-polymorphic only through retracing; shapes
 are static per compilation, which is what XLA wants.
 """
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,9 @@ __all__ = [
     "plane_from_columns",
     "columns_from_plane",
     "topn_counts",
+    "pairwise_counts",
+    "pairwise_counts_hi_lo",
+    "pairwise_tile",
     "hi_lo",
     "combine_hi_lo",
 ]
@@ -184,6 +187,89 @@ def _topn_counts_jnp(stack, filter_plane, k):
     counts = popcount_rows(stack & filter_plane[None, :])
     vals, idx = jax.lax.top_k(counts, k)
     return vals, idx
+
+
+# Per-axis row budget for one pairwise tile ([tile, S, W] stack). Matches
+# exec.stacked.CHUNK_BYTES so a tile stack never exceeds one row-chunk
+# upload; the serving layer derives its tile from CHUNK_BYTES directly.
+PAIRWISE_TILE_BYTES = 128 * 1024 * 1024
+
+
+def pairwise_tile(n_shards):
+    """Rows per pairwise tile axis under the PAIRWISE_TILE_BYTES budget."""
+    return max(1, PAIRWISE_TILE_BYTES // (n_shards * WORDS_PER_ROW * 4))
+
+
+@lru_cache(maxsize=4)
+def _pairwise_hi_lo_fn(has_filt):
+    """(A [R1,S,W], B [R2,S,W], filt [S,W]?) -> (hi [R1,R2], lo [R1,R2])
+    cross-product intersect counts, reduced over shards with the hi_lo
+    overflow split. The A axis folds through a lax.map so the broadcast
+    intermediate stays [R2, S, W] (one B-stack's worth) instead of
+    materializing the full [R1, R2, S, W] cross product."""
+
+    @jax.jit
+    def fn(a, b, *filt):
+        bf = b & filt[0][None] if has_filt else b
+
+        def per_a(a_row):
+            pc = jax.lax.population_count(a_row[None] & bf).astype(jnp.int32)
+            return jnp.sum(pc, axis=-1)          # [R2, S]
+
+        per_shard = jax.lax.map(per_a, a)        # [R1, R2, S]
+        return hi_lo(per_shard, axis=-1)
+
+    return fn
+
+
+def pairwise_counts_hi_lo(a, b, filt=None):
+    """One-tile pairwise intersect-count matrix as a device (hi, lo) pair:
+    counts[i, j] = Σ_{s,w} popcount(a[i] & b[j] & filt). a: [R1, S, W],
+    b: [R2, S, W], filt: [S, W] or None. Dispatches to the Pallas backend
+    under the same opt-in gate as the count kernels when the per-pair bit
+    budget fits its plain-int32 accumulator and the inputs live on one
+    device (pallas_call can't be GSPMD-partitioned)."""
+    from . import pallas_kernels
+    from ..parallel.sharded import _is_multi_device
+
+    if a.shape[0] == 0 or b.shape[0] == 0:
+        z = jnp.zeros((a.shape[0], b.shape[0]), jnp.int32)
+        return z, z
+    n_bits = a.shape[1] * a.shape[2] * 32
+    if pallas_kernels.enabled() and n_bits < 2**31 \
+            and not _is_multi_device(a) and not _is_multi_device(b):
+        m = pallas_kernels.pairwise_counts_stack(a, b, filt)
+        # totals < 2^31 by the gate, so the plain split satisfies the
+        # combine_hi_lo contract total = (hi << 16) + lo exactly
+        return m >> 16, m & 0xFFFF
+    fn = _pairwise_hi_lo_fn(filt is not None)
+    if filt is not None:
+        return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(filt))
+    return fn(jnp.asarray(a), jnp.asarray(b))
+
+
+def pairwise_counts(A, B, filt=None, tile=None):
+    """Host [R1, R2] int64 matrix of pairwise intersect counts over row
+    stacks A [R1, S, W] and B [R2, S, W] (filt [S, W] optional) — the
+    GroupBy cross product as one tiled popcount matrix instead of R1·R2
+    per-combination scans (reference: executor.go:1238 iterates fragment
+    scans per group). Tiled over BOTH row axes so device memory stays
+    bounded by ~2·PAIRWISE_TILE_BYTES regardless of R1·R2; each tile pair
+    is one fused dispatch + one host sync."""
+    R1, R2 = int(A.shape[0]), int(B.shape[0])
+    out = np.zeros((R1, R2), dtype=np.int64)
+    if R1 == 0 or R2 == 0:
+        return out
+    if tile is None:
+        tile = pairwise_tile(int(A.shape[1]))
+    dfilt = jnp.asarray(filt) if filt is not None else None
+    for i in range(0, R1, tile):
+        a = jnp.asarray(A[i:i + tile])
+        for j in range(0, R2, tile):
+            b = jnp.asarray(B[j:j + tile])
+            hi, lo = pairwise_counts_hi_lo(a, b, dfilt)
+            out[i:i + tile, j:j + tile] = combine_hi_lo(hi, lo)
+    return out
 
 
 def topn_counts(stack, filter_plane, k):

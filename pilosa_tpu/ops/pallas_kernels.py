@@ -33,6 +33,7 @@ __all__ = [
     "count_intersect_stack",
     "count_expr_stack",
     "topn_counts_stack",
+    "pairwise_counts_stack",
     "bsi_range_mask",
 ]
 
@@ -202,6 +203,101 @@ def _topn_call(n_rows, interpret):
         return call(rows, filt)[:, 0]
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Pairwise intersect-count matrix (GroupBy cross product)
+# ---------------------------------------------------------------------------
+#
+# counts[i, j] = Σ_w popcount(A[i] & B[j] & filt) — matmul loop structure
+# with popcount+add in place of multiply+add: the grid walks (A block,
+# B block, word block) with the word axis innermost, the [8, 128] count
+# tile accumulates in place across word blocks, and each step streams one
+# B row block against the A block while the output tile stays resident.
+
+# A rows per block (sublanes of the output tile).
+_PW_A_BLOCK = 8
+# B rows per block (lanes of the output tile).
+_PW_B_BLOCK = 128
+# Words per grid step: B block 128 x 4096 x 4 B = 2 MiB in VMEM; the
+# flattened [R, S*W] word axis is always a multiple (W = 32768).
+_PW_BLOCK_WORDS = 4096
+
+
+def _pairwise_kernel(has_filt):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        if has_filt:
+            a_ref, b_ref, filt_ref, out_ref = refs
+            a = a_ref[:] & filt_ref[:]
+        else:
+            a_ref, b_ref, out_ref = refs
+            a = a_ref[:]
+        b = b_ref[:]
+        # Unrolled over the (static, small) A block: each step is a
+        # [B_BLOCK, W_BLOCK] AND+popcount reduced to one output row.
+        rows = []
+        for i in range(_PW_A_BLOCK):
+            pc = jax.lax.population_count(a[i][None, :] & b)
+            rows.append(jnp.sum(pc.astype(jnp.int32), axis=-1))
+        part = jnp.stack(rows)                   # [A_BLOCK, B_BLOCK]
+
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros((_PW_A_BLOCK, _PW_B_BLOCK), jnp.int32)
+
+        out_ref[:] += part
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _pairwise_call(n_r1, n_r2, n_words, has_filt, interpret):
+    from jax.experimental import pallas as pl
+
+    grid = (n_r1 // _PW_A_BLOCK, n_r2 // _PW_B_BLOCK,
+            n_words // _PW_BLOCK_WORDS)
+    in_specs = [
+        pl.BlockSpec((_PW_A_BLOCK, _PW_BLOCK_WORDS),
+                     lambda i, j, w: (i, w)),
+        pl.BlockSpec((_PW_B_BLOCK, _PW_BLOCK_WORDS),
+                     lambda i, j, w: (j, w)),
+    ]
+    if has_filt:
+        in_specs.append(
+            pl.BlockSpec((1, _PW_BLOCK_WORDS), lambda i, j, w: (0, w)))
+    call = pl.pallas_call(
+        _pairwise_kernel(has_filt),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((_PW_A_BLOCK, _PW_B_BLOCK),
+                               lambda i, j, w: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_r1, n_r2), jnp.int32),
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def pairwise_counts_stack(a, b, filt=None):
+    """[R1, R2] int32 pairwise intersect-count matrix over row stacks
+    a [R1, S, W] and b [R2, S, W] (filt [S, W] optional). Plain int32
+    accumulation — callers gate on S*SHARD_WIDTH < 2^31 set bits, exactly
+    as QueryKernels.count_expr gates the count kernels. Zero padding rows
+    contributes zero counts and is sliced off before returning."""
+    r1, r2 = a.shape[0], b.shape[0]
+    if r1 == 0 or r2 == 0:
+        return jnp.zeros((r1, r2), jnp.int32)
+    t = a.shape[1] * a.shape[2]
+    a2 = _pad_rows(jnp.asarray(a).reshape(r1, t), _PW_A_BLOCK)
+    b2 = _pad_rows(jnp.asarray(b).reshape(r2, t), _PW_B_BLOCK)
+    run = _pairwise_call(a2.shape[0], b2.shape[0], t, filt is not None,
+                         _interpret())
+    if filt is not None:
+        out = run(a2, b2, jnp.asarray(filt).reshape(1, t))
+    else:
+        out = run(a2, b2)
+    return out[:r1, :r2]
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,16 @@ def apply_op_chain(acc, planes, ops):
     return acc
 
 
+def _shard_map():
+    """shard_map across jax versions: top-level export on recent jax,
+    jax.experimental on 0.4.x."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 def _is_multi_device(x):
     """True when `x` is a jax array spanning more than one device."""
     sharding = getattr(x, "sharding", None)
@@ -174,8 +184,9 @@ class ShardedQueryEngine:
         """Distributed Intersect+Count: local popcount per device slice,
         psum across the shard axis over ICI."""
         jax, jnp = _jax()
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        shard_map = _shard_map()
 
         hi_lo, combine = _hi_lo()
         key = ("count_intersect",)
@@ -202,8 +213,9 @@ class ShardedQueryEngine:
         One jit per (ops, arity): elementwise chain on the local slice, one
         psum across ICI."""
         jax, jnp = _jax()
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        shard_map = _shard_map()
 
         hi_lo, combine = _hi_lo()
         key = ("expr", ops, len(planes))
@@ -231,8 +243,9 @@ class ShardedQueryEngine:
         one jitted program (reference analog: per-node TopN + heap merge,
         executor.go:930)."""
         jax, jnp = _jax()
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        shard_map = _shard_map()
 
         hi_lo, combine = _hi_lo()
         key = ("topn",)
@@ -260,12 +273,52 @@ class ShardedQueryEngine:
         order = np.lexsort((np.arange(len(totals)), -totals))[:k]
         return totals[order], order.astype(np.int32)
 
+    def pairwise_step(self, a, b, filt=None):
+        """Distributed pairwise intersect-count matrix (the GroupBy cross
+        product): a [R1, S, W] and b [R2, S, W] row stacks sharded over the
+        shard axis, optional filt [S, W]. Each device computes its local
+        [R1, R2] partial matrix (folding the A axis through lax.map so the
+        broadcast intermediate stays one B-stack wide), then the partials
+        psum over ICI. Returns the host int64 [R1, R2] matrix."""
+        jax, jnp = _jax()
+        from jax.sharding import PartitionSpec as P
+
+        shard_map = _shard_map()
+
+        hi_lo, combine = _hi_lo()
+        has_filt = filt is not None
+        key = ("pairwise", has_filt)
+        fn = self._compiled.get(key)
+        if fn is None:
+            in_specs = (P(None, self.axis), P(None, self.axis)) + (
+                (P(self.axis),) if has_filt else ())
+
+            @jax.jit
+            @partial(shard_map, mesh=self.mesh, in_specs=in_specs,
+                     out_specs=(P(), P()))
+            def fn(a, b, *filt):
+                bf = b & filt[0][None] if has_filt else b
+
+                def per_a(a_row):
+                    pc = jax.lax.population_count(a_row[None] & bf)
+                    return jnp.sum(pc.astype(jnp.int32), axis=-1)
+
+                per_shard = jax.lax.map(per_a, a)    # [R1, R2, S_local]
+                hi, lo = hi_lo(per_shard, axis=-1)
+                return (jax.lax.psum(hi, self.axis),
+                        jax.lax.psum(lo, self.axis))
+
+            self._compiled[key] = fn
+        args = (a, b, filt) if has_filt else (a, b)
+        return combine(*fn(*args))
+
     def sum_step(self, planes, sign, exists, filt):
         """Distributed BSI Sum: per-plane popcounts psum'd over shards.
         planes [D, S, W]; sign/exists/filt [S, W]."""
         jax, jnp = _jax()
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        shard_map = _shard_map()
 
         hi_lo, combine = _hi_lo()
         key = ("sum",)

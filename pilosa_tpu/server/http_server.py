@@ -118,6 +118,8 @@ class PilosaHTTPServer:
                   args=("index", "field", "view", "shard")),
             Route("GET", r"/internal/translate/data",
                   self._get_translate_data),
+            Route("POST", r"/internal/translate/data",
+                  self._post_translate_data),
             Route("POST", r"/internal/translate/keys",
                   self._post_translate_keys),
             Route("GET", r"/internal/attr/blocks", self._get_attr_blocks),
@@ -438,6 +440,15 @@ class PilosaHTTPServer:
         return self.api.translate_data(
             self._q1(req, "index"), self._q1(req, "field", ""),
             int(self._q1(req, "offset", "0")))
+
+    def _post_translate_data(self, req):
+        """POST sibling of the GET feed (reference: handler.go routes both
+        methods to handleGetTranslateData): replication readers that carry
+        the cursor in a JSON body instead of the query string."""
+        body = req.json() or {}
+        return self.api.translate_data(
+            body.get("index", ""), body.get("field", ""),
+            int(body.get("offset", 0)))
 
     def _post_translate_keys(self, req):
         body = req.json() or {}

@@ -81,6 +81,38 @@ def test_child_error_record_propagates():
     assert rec["error"] == "fake failure"
 
 
+def test_crashed_child_surfaces_error_without_burning_probe():
+    # A child that dies before the probe (import error, tunnel blowup)
+    # must be detected within a poll interval and its real error record
+    # propagated — NOT waited out to the probe deadline per attempt.
+    code, rec, elapsed = run_bench("crash", probe="30", attempts="4")
+    assert code == 1
+    assert rec["error"] == "fake crash"
+    assert elapsed < 25, \
+        f"crash detection burned probe deadlines: {elapsed:.1f}s"
+
+
+def test_cpu_fallback_record_when_every_probe_dies():
+    # Children hang unless retargeted at cpu: after all device attempts
+    # miss the probe, the orchestrator must take ONE labeled cpu
+    # measurement with tunnel diagnostics instead of a bare error line.
+    env_had = os.environ.get("JAX_PLATFORMS")
+    if env_had == "cpu":
+        del os.environ["JAX_PLATFORMS"]
+    try:
+        code, rec, _ = run_bench("tpu_hang", budget="60", probe="5",
+                                 attempts="2")
+    finally:
+        if env_had is not None:
+            os.environ["JAX_PLATFORMS"] = env_had
+    assert code == 0
+    assert rec["metric"] == "fake"
+    assert rec["extra"]["platform"] == "cpu-fallback"
+    tunnel = rec["extra"]["tunnel"]
+    assert tunnel["device_attempts"] == 2
+    assert tunnel["probe_deadline_s"] == 5.0
+
+
 @pytest.mark.skipif(
     not os.environ.get("PILOSA_TPU_BENCH_E2E"),
     reason="several-minute full bench; set PILOSA_TPU_BENCH_E2E=1 to run")

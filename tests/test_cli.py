@@ -6,8 +6,14 @@ the introspection commands that only print.
 """
 
 import io
-import tomllib
 from contextlib import redirect_stdout
+
+try:
+    import tomllib  # 3.11+
+except ImportError:  # same fallback chain as cli.py's --config loader
+    import pytest
+
+    tomllib = pytest.importorskip("tomli")
 
 from pilosa_tpu.cli import main
 

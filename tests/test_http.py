@@ -483,3 +483,71 @@ def test_unknown_query_params_rejected(srv):
     out = c._request("POST", "/index/qa/query?shards=0",
                      b"Count(Row(f=0))", content_type="text/plain")
     assert out["results"] == [0]
+
+
+def test_groupby_previous_pagination_e2e(srv):
+    """GroupBy list-cursor pagination over the wire: walk a 2-field cross
+    product to completion with limit + previous=[last group]; concatenated
+    pages equal the one-shot result, and a malformed cursor is a 400."""
+    from pilosa_tpu.server import ClientError
+
+    c = srv.client
+    c.create_index("gp")
+    c.create_field("gp", "a")
+    c.create_field("gp", "b")
+    cols = list(range(0, 240, 2)) + [SHARD_WIDTH + i for i in range(96)]
+    ra = [i % 3 for i in range(len(cols))]
+    rb = [10 + (i % 4) for i in range(len(cols))]
+    c.import_bits("gp", "a", ra, cols)
+    c.import_bits("gp", "b", rb, cols)
+
+    full = q(srv, "gp", "GroupBy(Rows(a), Rows(b))")[0]
+    assert len(full) == 12  # (i%3, i%4) cycles with period 12: all pairs
+    pages, prev = [], None
+    for _ in range(len(full) + 2):  # bounded: must terminate
+        pql = "GroupBy(Rows(a), Rows(b), limit=5{})".format(
+            "" if prev is None else f", previous=[{prev[0]}, {prev[1]}]")
+        page = q(srv, "gp", pql)[0]
+        if not page:
+            break
+        assert len(page) <= 5
+        pages.extend(page)
+        prev = (page[-1]["group"][0]["rowID"],
+                page[-1]["group"][1]["rowID"])
+    assert pages == full
+
+    with pytest.raises(ClientError) as e:
+        q(srv, "gp", "GroupBy(Rows(a), Rows(b), previous=[1])")
+    assert e.value.status == 400
+    assert "previous" in str(e.value)
+
+
+def test_translate_data_post_matches_get(srv):
+    """POST /internal/translate/data with a JSON-body cursor serves the
+    same replication feed as the GET query-string form (reference:
+    handler.go routes both methods to the translate-data handler)."""
+    c = srv.client
+    c.create_index("tk", keys=True)
+    c.create_field("tk", "kf", {"type": "set", "keys": True})
+    c._request("POST", "/internal/translate/keys", json.dumps(
+        {"index": "tk", "keys": ["alpha", "beta", "gamma"]}).encode())
+    c._request("POST", "/internal/translate/keys", json.dumps(
+        {"index": "tk", "field": "kf", "keys": ["r1", "r2"]}).encode())
+
+    for field in ("", "kf"):
+        got = c._request("POST", "/internal/translate/data", json.dumps(
+            {"index": "tk", "field": field, "offset": 0}).encode())
+        want = c.translate_entries("tk", field=field, offset=0)
+        assert got == want
+        assert len(got["entries"]) >= 2
+        # body-borne offset resumes mid-feed exactly like the query string
+        got = c._request("POST", "/internal/translate/data", json.dumps(
+            {"index": "tk", "field": field, "offset": 1}).encode())
+        assert got == c.translate_entries("tk", field=field, offset=1)
+
+    from pilosa_tpu.server import ClientError
+
+    with pytest.raises(ClientError) as e:
+        c._request("POST", "/internal/translate/data",
+                   json.dumps({"index": "nope"}).encode())
+    assert e.value.status == 404

@@ -432,3 +432,38 @@ def test_time_range_count_via_collective(cluster):
     assert got == 3
     after = _spmd_steps(cluster)
     assert all(a - b == 1 for a, b in zip(after, before)), (before, after)
+
+
+def test_groupby_previous_pagination_any_plane(cluster):
+    """GroupBy list-cursor pagination answers identically over the --spmd
+    cluster: the cursor is validated and the outer row start seeded before
+    any merge, and pages concatenate to the one-shot result whichever data
+    plane (collective or HTTP fallback) carries the counts."""
+    coord = cluster.clients[cluster.coord]
+    coord.create_field("sp", "pa")
+    coord.create_field("sp", "pb")
+    time.sleep(1.0)  # DDL broadcast settles
+    cols = [s * SHARD_WIDTH + off for s in range(6) for off in range(8)]
+    coord.import_bits("sp", "pa", [i % 3 for i in range(len(cols))], cols)
+    coord.import_bits("sp", "pb", [i % 4 for i in range(len(cols))], cols)
+
+    full = coord.query("sp", "GroupBy(Rows(pa), Rows(pb))")["results"][0]
+    assert len(full) == 12  # (i%3, i%4) cycles with period 12: all pairs
+    pages, prev = [], None
+    for _ in range(len(full) + 2):  # bounded: must terminate
+        pql = "GroupBy(Rows(pa), Rows(pb), limit=5{})".format(
+            "" if prev is None else f", previous=[{prev[0]}, {prev[1]}]")
+        page = coord.query("sp", pql)["results"][0]
+        if not page:
+            break
+        assert len(page) <= 5
+        pages.extend(page)
+        prev = (page[-1]["group"][0]["rowID"],
+                page[-1]["group"][1]["rowID"])
+    assert pages == full
+
+    # a malformed cursor errors on the wire instead of serving page 1
+    from pilosa_tpu.server import ClientError
+
+    with pytest.raises(ClientError):
+        coord.query("sp", "GroupBy(Rows(pa), Rows(pb), previous=[1])")

@@ -1,0 +1,195 @@
+"""The SPMD validate-to-step wedge window (cluster/spmd.py watchdog).
+
+Scenario: a peer answers /internal/spmd/validate OK (or the count epoch
+is already validated), then dies before its step runs. The collective
+cannot rendezvous — it times out and raises on the coordinator — and the
+maybe_execute watchdog must (a) fall back to the HTTP merge so the query
+still answers correctly, (b) invalidate the validation epoch, and (c)
+let the NEXT spmd query re-validate and ride the collective again once
+the mesh is whole.
+
+Driven at the data-plane layer over a real in-process HTTP cluster
+(harness.ClusterHarness): on this jax build a process-level SIGKILL
+cannot reach the watchdog at all — multiprocess collectives are
+unimplemented on the CPU backend, and the JAX coordination service
+terminates every surviving process when any task dies (observed:
+client.h:80 "Terminating process because the JAX distributed service
+detected fatal errors"), taking the coordinator down with the victim.
+The collective failure is therefore injected where a dead peer
+manifests on the coordinator: _run_step_locked raising out of the
+rendezvous. tests/test_spmd.py covers the real 3-process mesh where the
+platform supports it.
+"""
+
+import pytest
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from .harness import ClusterHarness
+
+
+@pytest.fixture()
+def spmd_cluster():
+    from pilosa_tpu.cluster.spmd import SpmdDataPlane
+    from pilosa_tpu.server import API, Client
+
+    c = ClusterHarness(3)
+    try:
+        for h in c.nodes:
+            spmd = SpmdDataPlane(h.holder, h.cluster, Client)
+            h.api = API(h.holder, cluster=h.cluster, client_factory=Client,
+                        spmd=spmd)
+            h.server.api = h.api
+            h.spmd = spmd
+        coord_id = min(h.cluster.local_id for h in c.nodes)
+        c.coord = c.node_by_id(coord_id)
+        for h in c.nodes:
+            if h is not c.coord:
+                # In-process, every node's launch spans the SAME 8 local
+                # devices, so the coordinator's launch alone already
+                # computes the global result and peer responses are
+                # discarded; concurrent peer launches only race the
+                # device rendezvous (RunId mixing wedges it). Peers ack
+                # the step without launching.
+                h.spmd.run_step = lambda step: {"ok": True}
+        yield c
+    finally:
+        c.close()
+
+
+def _coord_shards(cluster, want=2, probe=40):
+    """First `want` shards whose primary owner is the coordinator node —
+    single-process spmd steps count only the executing node's local
+    blocks, so correctness needs the data on the coordinator."""
+    out = []
+    for s in range(probe):
+        if cluster.owner_of("wz", s) is cluster.coord:
+            out.append(s)
+            if len(out) == want:
+                return out
+    raise RuntimeError("coordinator owns too few probed shards")
+
+
+def test_wedge_window_watchdog_falls_back_and_recovers(spmd_cluster):
+    c = spmd_cluster
+    coord = c.coord
+    coord.client.create_index("wz")
+    coord.client.create_field("wz", "f")
+    shards = _coord_shards(c)
+    cols = [s * SHARD_WIDTH + off for s in shards for off in (0, 7, 99)]
+    coord.client.import_bits("wz", "f", [1] * len(cols), cols)
+
+    spmd = coord.spmd
+    # Prime: the validation round runs and the step rides the collective.
+    got = coord.client.query("wz", "Count(Row(f=1))")["results"][0]
+    assert got == len(cols)
+    assert spmd.steps_run >= 1
+    assert spmd.validations >= 1
+    steps0, vals0, falls0 = (spmd.steps_run, spmd.validations,
+                             spmd.fallbacks)
+
+    # Wedge: the peer validated (epoch is primed) then died before its
+    # step — on the coordinator that manifests as the collective raising
+    # out of the rendezvous.
+    real_run = spmd._run_step_locked
+
+    def dead_peer_collective(step):
+        raise RuntimeError(
+            "simulated: peer exited between validate and step "
+            "(collective rendezvous timeout)")
+
+    spmd._run_step_locked = dead_peer_collective
+    try:
+        got = coord.client.query("wz", "Count(Row(f=1))")["results"][0]
+    finally:
+        spmd._run_step_locked = real_run
+    # watchdog: correct answer via the HTTP merge, fallback recorded,
+    # no step completed, epoch invalidated for re-probe
+    assert got == len(cols)
+    assert spmd.fallbacks == falls0 + 1
+    assert spmd.steps_run == steps0
+    assert spmd._count_epochs.get("wz") is None
+
+    # Recovery: mesh whole again — the next spmd query re-validates
+    # (fresh epoch, not a stale skip) and rides the collective.
+    got = coord.client.query("wz", "Count(Row(f=1))")["results"][0]
+    assert got == len(cols)
+    assert spmd.steps_run == steps0 + 1
+    assert spmd.validations == vals0 + 1
+
+
+def test_wedge_window_groupby_falls_back(spmd_cluster):
+    """Same watchdog contract on the GroupBy pairwise-era path: the
+    collective failure must not error the query OR leave a stale epoch."""
+    c = spmd_cluster
+    coord = c.coord
+    coord.client.create_index("wz")
+    coord.client.create_field("wz", "a")
+    coord.client.create_field("wz", "b")
+    shards = _coord_shards(c)
+    cols = [s * SHARD_WIDTH + off for s in shards for off in range(8)]
+    coord.client.import_bits(
+        "wz", "a", [i % 2 for i in range(len(cols))], cols)
+    coord.client.import_bits(
+        "wz", "b", [i % 3 for i in range(len(cols))], cols)
+
+    def groups(res):
+        return {tuple(fr["rowID"] for fr in g["group"]): g["count"]
+                for g in res}
+
+    want = groups(coord.client.query(
+        "wz", "GroupBy(Rows(a), Rows(b))")["results"][0])
+    assert want  # non-empty cross product
+
+    spmd = coord.spmd
+    falls0 = spmd.fallbacks
+    real_run = spmd._run_step_locked
+    spmd._run_step_locked = lambda step: (_ for _ in ()).throw(
+        RuntimeError("simulated dead peer"))
+    try:
+        got = groups(coord.client.query(
+            "wz", "GroupBy(Rows(a), Rows(b))")["results"][0])
+    finally:
+        spmd._run_step_locked = real_run
+    assert got == want
+    assert spmd.fallbacks == falls0 + 1
+
+
+def test_groupby_previous_pagination_rides_collective(spmd_cluster):
+    """The spmd GroupBy step honors the `previous` list cursor identically
+    to the local path: the cursor is validated and the outer row start
+    seeded BEFORE the collective round, pages concatenate to the one-shot
+    result, and every page still rides the collective (no fallback)."""
+    c = spmd_cluster
+    coord = c.coord
+    coord.client.create_index("wz")
+    coord.client.create_field("wz", "a")
+    coord.client.create_field("wz", "b")
+    shards = _coord_shards(c)
+    cols = [s * SHARD_WIDTH + off for s in shards for off in range(12)]
+    coord.client.import_bits(
+        "wz", "a", [i % 3 for i in range(len(cols))], cols)
+    coord.client.import_bits(
+        "wz", "b", [i % 4 for i in range(len(cols))], cols)
+
+    full = coord.client.query(
+        "wz", "GroupBy(Rows(a), Rows(b))")["results"][0]
+    assert len(full) == 12
+
+    spmd = c.coord.spmd
+    steps0, falls0 = spmd.steps_run, spmd.fallbacks
+    pages, prev = [], None
+    n_pages = 0
+    for _ in range(len(full) + 2):  # bounded: must terminate
+        pql = "GroupBy(Rows(a), Rows(b), limit=5{})".format(
+            "" if prev is None else f", previous=[{prev[0]}, {prev[1]}]")
+        page = coord.client.query("wz", pql)["results"][0]
+        if not page:
+            break
+        n_pages += 1
+        pages.extend(page)
+        prev = (page[-1]["group"][0]["rowID"],
+                page[-1]["group"][1]["rowID"])
+    assert pages == full
+    assert spmd.fallbacks == falls0
+    assert spmd.steps_run - steps0 >= n_pages  # each page: collective

@@ -446,6 +446,115 @@ def test_topn_rows_stack_patch_on_write(tmp_path):
     holder.close()
 
 
+# ---------------------------------------------------- pairwise GroupBy fused
+
+
+def _build_groupby_index(tmp_path, name, n_shards=3, n=420, seed=17):
+    holder = Holder(str(tmp_path / name)).open()
+    api = API(holder)
+    api.create_index("i")
+    for fname in ("ga", "gb", "gc", "flt"):
+        api.create_field("i", fname)
+    rng = np.random.default_rng(seed)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=n, replace=False)
+    ra = rng.integers(0, 5, size=n)
+    rb = rng.integers(10, 14, size=n)
+    rc = rng.integers(0, 3, size=n)
+    api.import_bits("i", "ga", ra.tolist(), cols.tolist())
+    api.import_bits("i", "gb", rb.tolist(), cols.tolist())
+    api.import_bits("i", "gc", rc.tolist(), cols.tolist())
+    sel = cols[cols % 2 == 0]
+    api.import_bits("i", "flt", [1] * len(sel), sel.tolist())
+    return holder, api, cols, ra, rb, rc
+
+
+@pytest.mark.parametrize("with_filter", [False, True])
+def test_groupby_three_fields_pairwise_matches_per_shard(
+        tmp_path, with_filter):
+    """>2 GroupBy fields: outer levels recurse over [S, W] planes, the
+    innermost TWO ride the fused pairwise kernel. Must agree exactly with
+    the untouched per-shard fallback AND the host ground truth, with and
+    without a filter."""
+    from pilosa_tpu.pql import parse
+
+    holder, api, cols, ra, rb, rc = _build_groupby_index(
+        tmp_path, f"g3{int(with_filter)}")
+    e = Executor(holder)
+    idx = holder.index("i")
+    fields = [idx.field(f) for f in ("gc", "ga", "gb")]
+    child_rows = [sorted(set(rc.tolist())), sorted(set(ra.tolist())),
+                  sorted(set(rb.tolist()))]
+    filter_call = parse("Row(flt=1)").calls[0] if with_filter else None
+    shard_list = sorted(idx.available_shards())
+
+    pd0 = e._stacked.pairwise_dispatches
+    stacked = e._group_by_stacked(
+        idx, fields, child_rows, filter_call, shard_list)
+    assert stacked is not None
+    assert e._stacked.pairwise_dispatches > pd0  # pairwise kernel ran
+    per_shard = e._group_by_per_shard(
+        idx, fields, child_rows, filter_call, shard_list)
+    assert stacked == per_shard
+
+    want = {}
+    for c, x, y, z in zip(cols.tolist(), rc.tolist(), ra.tolist(),
+                          rb.tolist()):
+        if with_filter and c % 2 != 0:
+            continue
+        want[(x, y, z)] = want.get((x, y, z), 0) + 1
+    assert stacked == want
+    holder.close()
+
+
+def test_groupby_pairwise_dispatch_tile_bound(tmp_path, monkeypatch):
+    """Acceptance: pairwise dispatches AND host syncs per GroupBy are
+    O(⌈R1/tile⌉·⌈R2/tile⌉), NOT O(R1·R2) — force tile < R by shrinking
+    the chunk budget, then count both on the serving cache."""
+    import math
+
+    import pilosa_tpu.exec.stacked as stacked_mod
+
+    holder, api, cols, ra, rb, rc = _build_groupby_index(tmp_path, "tile")
+    e = Executor(holder)
+    idx = holder.index("i")
+    st = e._stacked
+    shards = tuple(sorted(idx.available_shards()))
+    row_bytes = st._padded_len(shards) * WORDS_PER_ROW * 4
+    monkeypatch.setattr(stacked_mod, "CHUNK_BYTES", 2 * row_bytes)
+    tile = st.row_chunk_size(shards)
+    assert tile == 2
+
+    r1 = len(set(ra.tolist()))
+    r2 = len(set(rb.tolist()))
+    assert tile < min(r1, r2)
+    e.execute("i", "GroupBy(Rows(ga), Rows(gb))")  # warm stacks + compiles
+    d0, s0 = st.pairwise_dispatches, st.pairwise_syncs
+    got = e.execute("i", "GroupBy(Rows(ga), Rows(gb))")[0]
+    want_pairs = math.ceil(r1 / tile) * math.ceil(r2 / tile)
+    assert st.pairwise_dispatches - d0 == want_pairs
+    assert st.pairwise_syncs - s0 == want_pairs
+    assert want_pairs < r1 * r2  # strictly better than one trip per pair
+
+    # the tiled result is still exact
+    want = {}
+    for x, y in zip(ra.tolist(), rb.tolist()):
+        want[(x, y)] = want.get((x, y), 0) + 1
+    got_map = {
+        (g.group[0].row_id, g.group[1].row_id): g.count for g in got}
+    assert got_map == want
+    holder.close()
+
+
+def test_groupby_pairwise_counters_exported(tmp_path):
+    holder, api, cols, ra, rb, rc = _build_groupby_index(tmp_path, "ctr")
+    e = Executor(holder)
+    e.execute("i", "GroupBy(Rows(ga), Rows(gb))")
+    stats = e.stacked_stats()
+    assert stats["pairwise_dispatches"] >= 1
+    assert stats["pairwise_syncs"] >= 1
+    holder.close()
+
+
 # ------------------------------------------------------------ int32 overflow
 
 
