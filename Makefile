@@ -13,7 +13,7 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_shardwidth_matrix.py tests/test_tls.py \
 	tests/test_bench_orchestrator.py
 
-.PHONY: test test-core test-distributed lint bench-cpu
+.PHONY: test test-core test-distributed test-observability lint bench-cpu
 
 test: test-core test-distributed
 
@@ -23,6 +23,12 @@ test-core:
 
 test-distributed:
 	$(PY) -m pytest $(DISTRIBUTED) $(PYTEST_FLAGS)
+
+# Query observability surface: per-query profiles, histograms, the
+# slow-query log, trace retention, and the exposition formats.
+test-observability:
+	$(PY) -m pytest tests/test_observability.py tests/test_stats.py \
+		tests/test_tracing.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

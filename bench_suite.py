@@ -550,6 +550,41 @@ def bench_groupby_pairwise():
     ex.execute("gp", "GroupBy(Rows(a), Rows(b))")
     qps = _measure_qps(
         lambda i: ex.execute("gp", "GroupBy(Rows(a), Rows(b))"), n_q)
+
+    # Observability leg: the same GroupBy through api.Query with and
+    # without ?profile=true, plus the cost of the DISABLED path. With no
+    # profile active, the per-dispatch instrumentation is one
+    # profile.current() empty-dict probe — measured directly and asserted
+    # under 2% of the pairwise kernel wall so the nop default stays free.
+    from pilosa_tpu.exec import ExecOptions
+    from pilosa_tpu.utils import profile as profile_mod
+
+    api_q = api
+    api_q.executor = ex  # same warmed stacks for both legs
+    api_q.query("gp", "GroupBy(Rows(a), Rows(b))")  # warm the api path
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api_q.query("gp", "GroupBy(Rows(a), Rows(b))")
+    nop_ms = (time.perf_counter() - t0) / n_q * 1000
+    prof_opts = ExecOptions(profile=True)
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api_q.query("gp", "GroupBy(Rows(a), Rows(b))", options=prof_opts)
+    profiled_ms = (time.perf_counter() - t0) / n_q * 1000
+    profile_mod.take_last()  # drop the stashed tree
+
+    n_probe = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        profile_mod.current()
+    probe_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    pw_disp_per_q = max(
+        1, (d2["pairwise_dispatches"] - d1["pairwise_dispatches"]) // n_q)
+    nop_overhead_pct = probe_ns * pw_disp_per_q / 1e6 / pw_ms * 100
+    assert nop_overhead_pct < 2.0, (
+        f"disabled-profiling probe costs {nop_overhead_pct:.3f}% of the "
+        "pairwise kernel wall — no longer a zero-overhead default")
+
     rtt = _dispatch_rtt_ms()
     _close(holder)
     _emit("groupby_pairwise_qps", qps, 1000.0 / rec_ms, {
@@ -562,6 +597,10 @@ def bench_groupby_pairwise():
             (d2["pairwise_dispatches"] - d1["pairwise_dispatches"]) // n_q,
         "pairwise_syncs_per_q":
             (d2["pairwise_syncs"] - d1["pairwise_syncs"]) // n_q,
+        "api_nop_ms": round(nop_ms, 2),
+        "api_profiled_ms": round(profiled_ms, 2),
+        "profile_probe_ns": round(probe_ns, 1),
+        "nop_overhead_pct": round(nop_overhead_pct, 4),
         "dispatch_rtt_ms": rtt})
 
 

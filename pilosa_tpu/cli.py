@@ -226,6 +226,16 @@ def cmd_server(args):
         stats, interval=parse_duration(
             config.get("metric-poll-interval", "10s"))).start()
 
+    # Trace retention (GET /debug/traces): "memory" installs a bounded
+    # InMemoryTracer ring; the default keeps the nop tracer, whose hot
+    # path allocates no spans at all (query profiles via ?profile=true /
+    # long-query-time work either way).
+    if config.get("tracing") == "memory":
+        from .utils import tracing as _tracing
+
+        _tracing.set_tracer(_tracing.InMemoryTracer(
+            max_spans=int(config.get("trace-max-spans", 10000))))
+
     # Diagnostics phone-home: opt-in only, requires an explicit endpoint
     # (reference: diagnostics.go + server.go:760; default ON there, OFF
     # here — no default public endpoint).
@@ -653,7 +663,7 @@ def _apply_server_flags(config, args):
     once via viper for every subcommand)."""
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
                  "replicas", "spmd_port", "long_query_time",
-                 "max_writes_per_request"):
+                 "max_writes_per_request", "tracing"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -771,6 +781,11 @@ def main(argv=None):
                    choices=["local", "statsd", "none"],
                    help="metrics backend (default local registry; statsd "
                         "also emits UDP datagrams)")
+    p.add_argument("--tracing", default=None,
+                   choices=["none", "memory"],
+                   help="span retention: memory keeps a bounded ring of "
+                        "finished spans served at /debug/traces "
+                        "(default none: nop tracer, zero overhead)")
     p.add_argument("--statsd-host", default=None,
                    help="statsd host:port (default 127.0.0.1:8125)")
     p.add_argument("--tls-certificate", default=None,

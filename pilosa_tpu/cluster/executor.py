@@ -379,7 +379,14 @@ class ClusterExecutor:
 
         def run_node_traced(node, node_shards):
             with tracing.with_span(parent_span):
-                run_node(node, node_shards)
+                # Per-node fan-out span: its duration is this node's whole
+                # contribution (local execute or remote RTT + retries), so
+                # a profile shows WHICH node a slow fan-out waited on.
+                with tracing.start_span(
+                        "cluster.mapReduce.node", node=node.id,
+                        shards=len(node_shards),
+                        remote=node.id != self.cluster.local_id):
+                    run_node(node, node_shards)
 
         threads = []
         for node, node_shards in by_node.items():

@@ -294,8 +294,15 @@ class SpmdDataPlane:
             "minmax": self._try_minmax,
             "groupby": self._try_groupby,
         }[kind]
+        from ..utils import tracing
+
         try:
-            result = try_fn(idx, call, list(shards))
+            # the collective data plane is otherwise invisible to a query
+            # profile — this span records that the query went over SPMD
+            # (and how long the collective step took) instead of HTTP
+            with tracing.start_span("spmd.step", kind=kind,
+                                    shards=len(shards)):
+                result = try_fn(idx, call, list(shards))
         except Exception as e:
             # Watchdog: a wedged/failed collective (e.g. a peer that died
             # inside the amortized-validation window while still marked

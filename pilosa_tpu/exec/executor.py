@@ -213,16 +213,50 @@ class Executor:
 
             translate_calls(idx, query.calls)
 
+        from ..utils import profile as profile_mod
         from ..utils import tracing
+        from ..utils.stats import global_stats
+
+        import time as _time
+
+        # Per-query stacked-counter deltas: with a profile active, the
+        # before/after cache_stats diff attributes dispatches, cache
+        # traffic, and upload bytes to THIS query. The evaluator is
+        # shared, so concurrent queries can bleed into each other's
+        # deltas — still the right order of magnitude, and exact when
+        # queries are serialized (the acceptance path).
+        prof = profile_mod.current()
+        before = self._stacked.cache_stats() if prof is not None else None
 
         results = []
         with tracing.start_span(
                 "executor.Execute", index=index_name) as span:
             for call in query.calls:
+                t_call = _time.perf_counter()
                 with tracing.start_span(f"executor.execute{call.name}"):
                     results.append(self.execute_call(idx, call, shards, opt))
+                # per-PQL-op latency histogram (global registry: the
+                # executor predates any per-server stats wiring, and
+                # registry_of() resolves /metrics to this same registry)
+                global_stats.timing(
+                    "query_op_seconds", _time.perf_counter() - t_call,
+                    {"op": call.name})
             if span is not None:
                 span.set_tag("calls", len(query.calls))
+
+        if prof is not None:
+            after = self._stacked.cache_stats()
+            prof.set_tag("shards_touched",
+                         len(self._call_shards(idx, shards)))
+            for key, tag in (("dispatches", "dispatches"),
+                             ("pairwise_dispatches", "pairwise_dispatches"),
+                             ("pairwise_syncs", "pairwise_syncs"),
+                             ("hits", "cache_hits"),
+                             ("misses", "cache_misses")):
+                prof.add(tag, after[key] - before[key])
+            prof.add("bytes_materialized",
+                     (after["planes_uploaded"] - before["planes_uploaded"])
+                     * WORDS_PER_ROW * 4)
 
         if not opt.remote:
             results = translate_results(idx, query.calls, results)

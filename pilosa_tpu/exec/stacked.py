@@ -29,10 +29,15 @@ returns a (hi, lo) int32 pair — hi = Σ(count >> 16), lo = Σ(count & 0xffff)
 ≈ 34 trillion columns per node).
 """
 
+import contextlib
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
+
+from ..utils import profile as _profile
+from ..utils import tracing as _tracing
 
 
 class GroupCommit:
@@ -749,7 +754,7 @@ class StackedEvaluator:
             return None
         planes, sign, exists = data
         self.dispatches += 1
-        with self._dispatch_lock:
+        with self._locked_dispatch("bsi_condition"):
             return _launch_barrier(
                 apply_bsi_condition(plan, planes, sign, exists))
 
@@ -781,13 +786,42 @@ class StackedEvaluator:
         # the evaluator's own union fold: one fn-cache, one operator impl
         sig = ("|", tuple(("leaf", i) for i in range(len(stacks))))
         self.dispatches += 1
-        with self._dispatch_lock:
+        with self._locked_dispatch("time_union"):
             return _launch_barrier(self._plane_fn(sig, len(stacks))(*stacks))
 
     def row_chunk_size(self, shards):
         """Rows per [R, S, W] chunk under the CHUNK_BYTES budget."""
         return max(
             1, CHUNK_BYTES // (self._padded_len(shards) * WORDS_PER_ROW * 4))
+
+    @contextlib.contextmanager
+    def _locked_dispatch(self, kind):
+        """Hold the process-wide dispatch lock around one device launch.
+
+        With no QueryProfile active this is exactly the bare lock (the
+        probe is one empty-dict check — the zero-overhead default the
+        observability acceptance gate holds us to). With one active, it
+        measures how long THIS query waited on the lock vs how long its
+        kernel held it, emits a `stacked.kernel` child span (op=kind),
+        and accumulates the profile's lock-wait/kernel-wall totals —
+        the two numbers that split "slow query" into contention vs
+        compute."""
+        prof = _profile.current()
+        if prof is None:
+            with self._dispatch_lock:
+                yield
+            return
+        t0 = time.perf_counter()
+        with self._dispatch_lock:
+            t1 = time.perf_counter()
+            with _tracing.start_span("stacked.kernel", op=kind) as span:
+                if span is not None:
+                    span.set_tag("lock_wait_seconds", round(t1 - t0, 6))
+                yield
+            t2 = time.perf_counter()
+        prof.add("dispatch_lock_wait_seconds", t1 - t0)
+        prof.add("kernel_wall_seconds", t2 - t1)
+        prof.add("locked_dispatches", 1)
 
     # -- compiled kernels ----------------------------------------------------
 
@@ -909,7 +943,7 @@ class StackedEvaluator:
                     args.extend(payloads[pos][1])
                 for _ in range(size - len(chunk)):
                     args.extend(payloads[chunk[0]][1])  # pad: repeat q0
-                with self._dispatch_lock:
+                with self._locked_dispatch("count"):
                     his, los = fn(*args)
                     _launch_barrier((his, los))
                 outs.append((chunk, his, los))
@@ -1088,7 +1122,7 @@ class StackedEvaluator:
             return False, None
         sig, stacks = gathered
         self.dispatches += 1
-        with self._dispatch_lock:
+        with self._locked_dispatch("filter"):
             return True, _launch_barrier(
                 self._plane_fn(sig, len(stacks))(*stacks))
 
@@ -1117,7 +1151,7 @@ class StackedEvaluator:
             if stack is None:
                 return None
             self.dispatches += 1
-            with self._dispatch_lock:
+            with self._locked_dispatch("row_counts"):
                 hi_lo = fn(stack, filt) if filt is not None else fn(stack)
                 _launch_barrier(hi_lo)
                 if not cache:
@@ -1173,7 +1207,7 @@ class StackedEvaluator:
                     return None
                 self.dispatches += 1
                 self.pairwise_dispatches += 1
-                with self._dispatch_lock:
+                with self._locked_dispatch("pairwise"):
                     hi, lo = bitplane.pairwise_counts_hi_lo(
                         a_stack, b_stack, filt)
                     _launch_barrier((hi, lo))
@@ -1206,7 +1240,7 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._sum_fn(filt is not None)
         self.dispatches += 1
-        with self._dispatch_lock:
+        with self._locked_dispatch("sum"):
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:
@@ -1237,7 +1271,7 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._minmax_fn(filt is not None, is_max)
         self.dispatches += 1
-        with self._dispatch_lock:
+        with self._locked_dispatch("minmax"):
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:

@@ -246,22 +246,45 @@ class API:
 
     def query(self, index_name, pql, shards=None, options=None):
         """(reference: api.Query api.go:135)"""
+        import contextlib
+
+        from ..utils import profile as profile_mod
         from ..utils import tracing
 
         self._validate_state()
         if self.holder.index(index_name) is None:
             raise NotFoundError(f"index not found: {index_name}")
+        # Profile when the request asked (?profile=true) or a slow-query
+        # threshold is configured (so a slow query's log line carries the
+        # full span tree, not just its total). Remote fan-out legs never
+        # profile themselves — the coordinator's profile already captures
+        # them as cluster.mapReduce.node spans.
+        prof = None
+        if not (options is not None and options.remote) and (
+                (options is not None and options.profile)
+                or self.long_query_time is not None):
+            prof = profile_mod.begin(
+                index_name, pql if isinstance(pql, str) else str(pql),
+                slow_threshold=self.long_query_time)
         t0 = time.monotonic()
         try:
-            with tracing.start_span("api.Query", index=index_name):
-                query = parse(pql) if isinstance(pql, str) else pql
-                results = self.executor.execute(
-                    index_name, query, shards=shards, options=options)
+            with contextlib.ExitStack() as stack:
+                if prof is not None:
+                    # adopt the profile's root span so every span below —
+                    # and the stacked kernel dispatches — joins its trace
+                    stack.enter_context(tracing.with_span(prof.root))
+                with tracing.start_span("api.Query", index=index_name):
+                    query = parse(pql) if isinstance(pql, str) else pql
+                    results = self.executor.execute(
+                        index_name, query, shards=shards, options=options)
         except (ApiError,):
             raise
         except Exception as e:
             raise ApiError(str(e)) from e
-        self._log_slow_query(index_name, pql, time.monotonic() - t0)
+        finally:
+            if prof is not None:
+                prof.finish()
+        self._log_slow_query(index_name, pql, time.monotonic() - t0, prof)
         if any(c.writes() for c in query.calls):
             self._broadcast_shards_if_changed(index_name)
         return results
@@ -310,14 +333,24 @@ class API:
                 out.append({"id": c, "attrs": attrs})
         return out
 
-    def _log_slow_query(self, index_name, pql, elapsed):
-        """Slow-query log (reference: LongQueryTime api.go:1157)."""
+    def _log_slow_query(self, index_name, pql, elapsed, prof=None):
+        """Slow-query log (reference: LongQueryTime api.go:1157). With a
+        profile in hand the line carries the full span tree + counters as
+        JSON, so the log alone answers dispatch-count vs lock-wait vs
+        kernel-time vs fan-out."""
         if (self.long_query_time is not None
                 and elapsed > self.long_query_time):
+            import json as _json
+
             q = pql if isinstance(pql, str) else str(pql)
-            self.logger.printf(
-                "%.03fs SLOW QUERY index=%s %s", elapsed, index_name,
-                q[:500])
+            if prof is not None:
+                self.logger.printf(
+                    "%.03fs SLOW QUERY index=%s %s profile=%s", elapsed,
+                    index_name, q[:500], _json.dumps(prof.to_dict()))
+            else:
+                self.logger.printf(
+                    "%.03fs SLOW QUERY index=%s %s", elapsed, index_name,
+                    q[:500])
 
     # -- schema DDL ---------------------------------------------------------
 

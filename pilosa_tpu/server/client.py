@@ -99,9 +99,12 @@ class Client:
         return encoding.decode_query_response(data)
 
     def query(self, index, pql, shards=None, remote=False,
-              exclude_row_attrs=False, exclude_columns=False):
+              exclude_row_attrs=False, exclude_columns=False,
+              profile=False):
         """(reference: InternalClient.QueryNode http/client.go:268; remote
-        marks node-to-node fan-out requests that must not re-fan-out)"""
+        marks node-to-node fan-out requests that must not re-fan-out;
+        profile asks the server to return the query's span-tree profile
+        alongside the results)"""
         path = f"/index/{index}/query"
         params = []
         if shards is not None:
@@ -112,6 +115,8 @@ class Client:
             params.append("excludeRowAttrs=true")
         if exclude_columns:
             params.append("excludeColumns=true")
+        if profile:
+            params.append("profile=true")
         if params:
             path += "?" + "&".join(params)
         return self._request(

@@ -20,6 +20,9 @@ from .api import ApiError, NotFoundError, field_options_from_json, \
 class Route:
     def __init__(self, method, pattern, fn, args=None):
         self.method = method
+        # the raw pattern doubles as the route's metrics label: bounded
+        # cardinality, unlike raw request paths (satellite: per-route tags)
+        self.pattern = pattern
         self.regex = re.compile("^" + pattern + "$")
         self.fn = fn
         # allowed query-string arg names; None = no validation
@@ -70,7 +73,7 @@ class PilosaHTTPServer:
             Route("POST", r"/index/(?P<index>[^/]+)/query",
                   self._post_query,
                   args=("shards", "remote", "columnAttrs",
-                        "excludeRowAttrs", "excludeColumns")),
+                        "excludeRowAttrs", "excludeColumns", "profile")),
             Route("POST",
                   r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
                   self._post_import,
@@ -140,6 +143,8 @@ class PilosaHTTPServer:
                   self._set_coordinator),
             Route("GET", r"/metrics", self._get_metrics),
             Route("GET", r"/debug/vars", self._get_debug_vars),
+            Route("GET", r"/debug/queries", self._get_debug_queries),
+            Route("GET", r"/debug/traces", self._get_debug_traces),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
             Route("POST", r"/debug/pprof/profile/start",
                   self._profile_start),
@@ -223,16 +228,23 @@ class PilosaHTTPServer:
             shards = [int(s) for s in req.query["shards"][0].split(",") if s]
         column_attrs = \
             req.query.get("columnAttrs", ["false"])[0] == "true"
+        want_profile = req.query.get("profile", ["false"])[0] == "true"
         options = ExecOptions(
             remote=req.query.get("remote", ["false"])[0] == "true",
             column_attrs=column_attrs,
             exclude_columns=req.query.get(
                 "excludeColumns", ["false"])[0] == "true",
             exclude_row_attrs=req.query.get(
-                "excludeRowAttrs", ["false"])[0] == "true")
+                "excludeRowAttrs", ["false"])[0] == "true",
+            profile=want_profile)
         results = self.api.query(
             req.params["index"], pql, shards=shards, options=options)
         out = {"results": [result_to_json(r) for r in results]}
+        if want_profile:
+            from ..utils import profile as profile_mod
+
+            # api.query stashed the finished profile on this thread
+            out["profile"] = profile_mod.take_last()
         if column_attrs:
             # reference: QueryResponse "columnAttrs" JSON field
             out["columnAttrs"] = self.api.column_attr_sets(
@@ -523,6 +535,27 @@ class PilosaHTTPServer:
             out["spmd"] = self.api.spmd.stats()
         return RawResponse(_json.dumps(out).encode(), "application/json")
 
+    def _get_debug_queries(self, req):
+        """Recent query profiles, newest first (the bounded ring every
+        profiled query — ?profile=true or long-query-time — lands in)."""
+        from ..utils import profile as profile_mod
+
+        return profile_mod.recent()
+
+    def _get_debug_traces(self, req):
+        """Dump of the retained span ring when an InMemoryTracer is
+        installed (--tracing memory); tells you how to enable it when
+        the zero-overhead nop default is active."""
+        from ..utils import tracing
+
+        tracer = tracing.get_tracer()
+        if isinstance(tracer, tracing.InMemoryTracer):
+            return {"enabled": True, "maxSpans": tracer.max_spans,
+                    "spans": tracer.to_dicts()}
+        return {"enabled": False, "spans": [],
+                "hint": "run the server with --tracing memory to retain "
+                        "spans"}
+
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
     #    profile.cpu config server/config.go) --------------------------------
 
@@ -716,12 +749,14 @@ class PilosaHTTPServer:
         t0 = _time.perf_counter()
         status, payload, content_type = 404, {"error": "not found"}, \
             "application/json"
+        matched = None  # Route whose pattern labels this request's metrics
         for route in self.routes:
             if route.method != handler.command:
                 continue
             m = route.regex.match(path)
             if m is None:
                 continue
+            matched = route
             if route.args is not None:
                 unknown = set(query) - route.args
                 if unknown:
@@ -755,19 +790,28 @@ class PilosaHTTPServer:
             data = json.dumps(payload).encode()
         else:
             data = payload
-        handler.send_response(status)
-        handler.send_header("Content-Type", content_type)
-        handler.send_header("Content-Length", str(len(data)))
-        if self.allowed_origins:
-            handler.send_header("Vary", "Origin")
-        if cors:
-            handler.send_header("Access-Control-Allow-Origin", cors)
-        handler.end_headers()
-        handler.wfile.write(data)
-        self.stats.timing(
-            "http_request_seconds", _time.perf_counter() - t0,
-            {"path": path, "method": handler.command,
-             "status": str(status)})
+        # Per-route/per-status request metrics. Tagged with the matched
+        # route PATTERN, not the raw path — raw paths (index/field names)
+        # are unbounded-cardinality label values. The finally guarantees
+        # error responses — 400s, 404s ("unmatched"), 500s, even a write
+        # that died on a closed socket — are all counted.
+        try:
+            handler.send_response(status)
+            handler.send_header("Content-Type", content_type)
+            handler.send_header("Content-Length", str(len(data)))
+            if self.allowed_origins:
+                handler.send_header("Vary", "Origin")
+            if cors:
+                handler.send_header("Access-Control-Allow-Origin", cors)
+            handler.end_headers()
+            handler.wfile.write(data)
+        finally:
+            tags = {"route": matched.pattern if matched else "unmatched",
+                    "method": handler.command, "status": str(status)}
+            self.stats.timing(
+                "http_request_seconds", _time.perf_counter() - t0, tags)
+            if status >= 400:
+                self.stats.count("http_errors", 1, tags)
 
 
 class _SamplingProfiler:

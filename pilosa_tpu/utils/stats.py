@@ -7,12 +7,20 @@ backend set: in-process registry with Prometheus/expvar exposition
 (stats.go:164). `RuntimeMonitor` is the runtime sampler loop
 (server.go:813-860, gcnotify/gopsutil analog) publishing process gauges."""
 
+import bisect
 import json
 import os
 import socket
 import threading
 import time
 from collections import defaultdict
+
+#: log-spaced latency bucket upper bounds (seconds) shared by every
+#: timing series — 100µs to 10s, ~×2.5 per step, with an implicit +Inf
+#: bucket. Log spacing keeps relative error roughly constant from
+#: cache-hit kernels to slow cluster fan-outs.
+TIMING_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 def _key(name, tags):
@@ -21,12 +29,43 @@ def _key(name, tags):
     return name, tuple(sorted(tags.items()))
 
 
+def _escape_label(value):
+    """Escape one label VALUE per the Prometheus exposition format
+    (backslash, double-quote, and newline must be escaped; anything else
+    passes through)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _quantile(count, bucket_counts, q):
+    """Estimate the q-quantile from log-bucket counts: linear
+    interpolation inside the target bucket (Prometheus histogram_quantile
+    semantics; the lowest bucket interpolates from 0)."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, n in enumerate(bucket_counts):
+        if n <= 0:
+            continue
+        if cum + n >= target:
+            lo = 0.0 if i == 0 else TIMING_BUCKETS[i - 1]
+            # +Inf bucket: report the largest finite bound rather than inf
+            hi = TIMING_BUCKETS[i] if i < len(TIMING_BUCKETS) \
+                else TIMING_BUCKETS[-1]
+            return lo + (hi - lo) * (target - cum) / n
+        cum += n
+    return TIMING_BUCKETS[-1]
+
+
 class StatsClient:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters = defaultdict(float)
         self._gauges = {}
-        self._timings = defaultdict(lambda: [0, 0.0])  # count, total seconds
+        # per series: [count, total seconds, per-bucket counts (+Inf last)]
+        self._timings = defaultdict(
+            lambda: [0, 0.0, [0] * (len(TIMING_BUCKETS) + 1)])
 
     def count(self, name, value=1, tags=None):
         with self._lock:
@@ -41,37 +80,75 @@ class StatsClient:
             t = self._timings[_key(name, tags)]
             t[0] += 1
             t[1] += seconds
+            t[2][bisect.bisect_left(TIMING_BUCKETS, seconds)] += 1
 
     def snapshot(self):
+        """(counters, gauges, timings) — timings as (count, sum) pairs;
+        `histograms()` adds the bucket counts."""
         with self._lock:
             return (dict(self._counters), dict(self._gauges),
-                    {k: tuple(v) for k, v in self._timings.items()})
+                    {k: (v[0], v[1]) for k, v in self._timings.items()})
+
+    def histograms(self):
+        """{key: (count, sum, bucket_counts)} — bucket_counts are
+        per-bucket (NOT cumulative), +Inf last, aligned to
+        TIMING_BUCKETS."""
+        with self._lock:
+            return {k: (v[0], v[1], tuple(v[2]))
+                    for k, v in self._timings.items()}
 
     def prometheus_text(self):
         """Prometheus exposition format (reference: prometheus/prometheus.go
-        + /metrics route http/handler.go:282)."""
-        counters, gauges, timings = self.snapshot()
+        + /metrics route http/handler.go:282): escaped label values, one
+        # TYPE line per metric family, and real histogram series
+        (_bucket{le=...}/_count/_sum) for timings."""
+        counters, gauges, _ = self.snapshot()
+        hists = self.histograms()
         lines = []
+        seen_families = set()
 
-        def fmt(name, labels, value):
-            if labels:
-                inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        def family(fqname, typ):
+            # dedupe: one TYPE line per family, before its first sample
+            if fqname not in seen_families:
+                seen_families.add(fqname)
+                lines.append(f"# TYPE {fqname} {typ}")
+
+        def fmt(name, labels, value, extra=()):
+            pairs = tuple(labels) + tuple(extra)
+            if pairs:
+                inner = ",".join(
+                    f'{k}="{_escape_label(v)}"' for k, v in pairs)
                 return f"{name}{{{inner}}} {value}"
             return f"{name} {value}"
 
         for (name, labels), value in sorted(counters.items()):
-            lines.append(fmt(f"pilosa_tpu_{name}_total", labels, value))
+            fq = f"pilosa_tpu_{name}_total"
+            family(fq, "counter")
+            lines.append(fmt(fq, labels, value))
         for (name, labels), value in sorted(gauges.items()):
-            lines.append(fmt(f"pilosa_tpu_{name}", labels, value))
-        for (name, labels), (count, total) in sorted(timings.items()):
-            lines.append(fmt(f"pilosa_tpu_{name}_count", labels, count))
-            lines.append(fmt(f"pilosa_tpu_{name}_sum", labels, total))
+            fq = f"pilosa_tpu_{name}"
+            family(fq, "gauge")
+            lines.append(fmt(fq, labels, value))
+        for (name, labels), (count, total, buckets) in sorted(hists.items()):
+            fq = f"pilosa_tpu_{name}"
+            family(fq, "histogram")
+            cum = 0
+            for bound, n in zip(TIMING_BUCKETS, buckets):
+                cum += n
+                lines.append(fmt(f"{fq}_bucket", labels, cum,
+                                 extra=(("le", f"{bound:g}"),)))
+            lines.append(fmt(f"{fq}_bucket", labels, count,
+                             extra=(("le", "+Inf"),)))
+            lines.append(fmt(f"{fq}_count", labels, count))
+            lines.append(fmt(f"{fq}_sum", labels, total))
         return "\n".join(lines) + "\n"
 
     def expvar_json(self):
         """JSON snapshot (reference: expvar backend stats.go:84 + the
-        /debug/vars route http/handler.go:281)."""
-        counters, gauges, timings = self.snapshot()
+        /debug/vars route http/handler.go:281). Timings carry estimated
+        p50/p99 from the log buckets."""
+        counters, gauges, _ = self.snapshot()
+        hists = self.histograms()
 
         def flat(d):
             return {
@@ -83,8 +160,10 @@ class StatsClient:
         return json.dumps({
             "counters": flat(counters),
             "gauges": flat(gauges),
-            "timings": {k: {"count": c, "sum": s}
-                        for k, (c, s) in flat(timings).items()},
+            "timings": {k: {"count": c, "sum": s,
+                            "p50": _quantile(c, b, 0.50),
+                            "p99": _quantile(c, b, 0.99)}
+                        for k, (c, s, b) in flat(hists).items()},
         })
 
 
@@ -184,6 +263,44 @@ class RuntimeMonitor:
             self.stats.gauge("open_fds", len(os.listdir("/proc/self/fd")))
         except OSError:
             pass  # non-procfs platform
+        self._sample_devices()
+
+    def _sample_devices(self):
+        """Per-device JAX memory gauges so HBM pressure sits next to RSS.
+        Only samples when a backend is ALREADY initialized — metrics must
+        never be what initializes one (jax.local_devices() would, and in
+        --spmd mode that must wait for jax.distributed.initialize; see
+        cluster/spmd.py) — and tolerates backends that don't implement
+        memory_stats (CPU returns None/raises)."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge.backends_are_initialized():
+                return
+        except Exception:
+            return  # can't prove a live backend; don't risk initializing one
+        try:
+            for d in jax.local_devices():
+                mem = d.memory_stats()
+                if not mem:
+                    continue
+                tags = {"device": f"{d.platform}:{d.id}"}
+                if "bytes_in_use" in mem:
+                    self.stats.gauge("device_memory_bytes",
+                                     mem["bytes_in_use"], tags)
+                if "peak_bytes_in_use" in mem:
+                    self.stats.gauge("device_peak_memory_bytes",
+                                     mem["peak_bytes_in_use"], tags)
+                if "bytes_limit" in mem:
+                    self.stats.gauge("device_memory_limit_bytes",
+                                     mem["bytes_limit"], tags)
+        except Exception:
+            pass  # backend without memory introspection
 
     def _run(self):
         while not self._stop.wait(self.interval):

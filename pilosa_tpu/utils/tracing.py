@@ -44,6 +44,13 @@ class Span:
         if self.duration is None:
             self.duration = time.time() - self.start
 
+    def to_dict(self):
+        """JSON shape for /debug/traces and query profiles."""
+        return {"name": self.name, "traceID": self.trace_id,
+                "spanID": self.span_id, "parentID": self.parent_id,
+                "tags": dict(self.tags), "start": self.start,
+                "duration": self.duration}
+
 
 class NopTracer:
     """Default tracer: allocates nothing, records nothing."""
@@ -53,7 +60,9 @@ class NopTracer:
 
 
 class InMemoryTracer:
-    """Collects finished spans (bounded); for tests and debugging."""
+    """Collects finished spans in a bounded ring — the OLDEST spans are
+    evicted past max_spans, so /debug/traces always shows recent activity
+    on a long-lived server (trace retention); for tests and debugging."""
 
     def __init__(self, max_spans=10000):
         self.max_spans = max_spans
@@ -62,12 +71,18 @@ class InMemoryTracer:
 
     def on_finish(self, span):
         with self._lock:
-            if len(self.spans) < self.max_spans:
-                self.spans.append(span)
+            self.spans.append(span)
+            if len(self.spans) > self.max_spans:
+                del self.spans[:len(self.spans) - self.max_spans]
 
     def find(self, name):
         with self._lock:
             return [s for s in self.spans if s.name == name]
+
+    def to_dicts(self):
+        """JSON dump for GET /debug/traces, oldest first."""
+        with self._lock:
+            return [s.to_dict() for s in self.spans]
 
     def clear(self):
         with self._lock:
@@ -75,6 +90,11 @@ class InMemoryTracer:
 
 
 _global_tracer = NopTracer()
+
+# Secondary finished-span consumer (utils/profile.py registers its
+# per-query router here). Separate from the tracer so query profiling
+# works with the nop tracer still installed.
+_span_sink = None
 
 
 def set_tracer(tracer):
@@ -87,8 +107,17 @@ def get_tracer():
     return _global_tracer
 
 
+def set_span_sink(sink):
+    global _span_sink
+    _span_sink = sink
+
+
 def _new_id():
     return "%016x" % random.getrandbits(64)
+
+
+def new_trace_id():
+    return _new_id()
 
 
 def current_span():
@@ -130,6 +159,8 @@ def start_span(name, **tags):
         _local.span = prev
         span.finish()
         tracer.on_finish(span)
+        if _span_sink is not None:
+            _span_sink(span)
 
 
 # -- cross-node propagation (reference: handler extractTracing / client
@@ -165,3 +196,5 @@ def span_from_headers(name, headers, **tags):
         _local.span = prev
         span.finish()
         tracer.on_finish(span)
+        if _span_sink is not None:
+            _span_sink(span)

@@ -34,7 +34,12 @@ def test_expvar_json():
     data = json.loads(s.expvar_json())
     assert data["counters"]["q"] == 1
     assert data["gauges"]["g{a=b}"] == 2
-    assert data["timings"]["t"] == {"count": 1, "sum": 0.5}
+    t = data["timings"]["t"]
+    assert t["count"] == 1 and t["sum"] == 0.5
+    # log-bucket quantile estimates: a single 0.5s sample lands in the
+    # (0.25, 0.5] bucket, so both quantiles interpolate inside it
+    assert 0.25 <= t["p50"] <= 0.5
+    assert 0.25 <= t["p99"] <= 0.5
 
 
 def test_statsd_datagrams():
