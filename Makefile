@@ -13,7 +13,8 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_shardwidth_matrix.py tests/test_tls.py \
 	tests/test_bench_orchestrator.py
 
-.PHONY: test test-core test-distributed test-observability lint bench-cpu
+.PHONY: test test-core test-distributed test-observability test-parallel \
+	lint bench-cpu
 
 test: test-core test-distributed
 
@@ -29,6 +30,13 @@ test-distributed:
 test-observability:
 	$(PY) -m pytest tests/test_observability.py tests/test_stats.py \
 		tests/test_tracing.py $(PYTEST_FLAGS)
+
+# Worker-pool surface: pool unit tests, the workers=1 vs workers=8
+# differential corpus, and the concurrent-serving wedge guard.
+test-parallel:
+	$(PY) -m pytest tests/test_workpool.py \
+		tests/test_workpool_differential.py \
+		tests/test_workpool_serving.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

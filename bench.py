@@ -395,10 +395,29 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
     to.start()
 
     def kill(reason: str):
+        """Kill the child and salvage its last JSON record: a child that
+        printed an error line before wedging (partial run, OOM handler,
+        device fault) still gets its real failure into last_err instead
+        of an anonymous None."""
         print(f"bench: killing attempt ({reason})", file=sys.stderr,
               flush=True)
         proc.kill()
         proc.wait()
+        te.join(timeout=5)
+        to.join(timeout=5)
+        rec = _last_record(out_lines)
+        if rec is None:
+            return {"metric": "error", "value": 0, "unit": "",
+                    "vs_baseline": 0,
+                    "error": f"bench child killed: {reason}"}
+        if rec.get("metric") != "error":
+            # a partial measurement from a killed child is not a result
+            return {"metric": "error", "value": 0, "unit": "",
+                    "vs_baseline": 0,
+                    "error": f"bench child killed: {reason} "
+                             f"(last record: {rec.get('metric')})"}
+        rec.setdefault("error", f"bench child killed: {reason}")
+        return rec
 
     t0 = time.perf_counter()
     probe_timeout = min(probe_deadline, remaining)
@@ -413,9 +432,8 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
             exited_early = True
             break
         if time.perf_counter() - t0 >= probe_timeout:
-            kill(f"probe missed {probe_deadline:.0f}s deadline — "
-                 "tunnel hung?")
-            return None
+            return kill(f"probe missed {probe_deadline:.0f}s deadline — "
+                        "tunnel hung?")
     if not exited_early:
         # Full-run deadline = budget actually left, not budget minus the
         # probe's worst case — a 5s probe must not forfeit 70s of bench
@@ -424,8 +442,7 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
             proc.wait(
                 timeout=max(remaining - (time.perf_counter() - t0), 5.0))
         except subprocess.TimeoutExpired:
-            kill("full-run deadline")
-            return None
+            return kill("full-run deadline")
     te.join(timeout=5)
     to.join(timeout=5)
     rec = _last_record(out_lines)

@@ -67,6 +67,13 @@ def cmd_server(args):
     host, _, port = config["bind"].partition(":")
     data_dir = os.path.expanduser(config["data-dir"])
 
+    # Size the shared host-work pool before anything can submit to it
+    # (default min(32, cpu); workers=1 == serial execution).
+    if config.get("workers") is not None:
+        from .utils import workpool
+
+        workpool.configure(int(config["workers"]))
+
     # SPMD pod mode: join the global JAX distributed system BEFORE anything
     # can initialize a backend (same once-only constraint as platform
     # selection). Process id = this node's position in the (identical on
@@ -251,21 +258,21 @@ def cmd_server(args):
             interval=parse_duration(diag_cfg.get("interval", "1h")),
             logger=StandardLogger()).start()
 
+    # TLS + CORS come from the MERGED config only — _apply_server_flags
+    # already folded the flags in, so `pilosa_tpu config` output is
+    # exactly what runs here (reference: handler.allowed-origins
+    # server/config.go:75).
     tls_cfg = config.get("tls", {}) if isinstance(
         config.get("tls", {}), dict) else {}
-    # CORS (reference: handler.allowed-origins server/config.go:75)
     origins = config.get("handler", {}).get("allowed-origins", []) \
         if isinstance(config.get("handler", {}), dict) else []
-    if getattr(args, "allowed_origins", None):
-        origins = args.allowed_origins
     if isinstance(origins, str):  # scalar TOML value / comma-joined flag
         origins = origins.split(",")
     origins = [o.strip() for o in origins if o.strip()]
     server = PilosaHTTPServer(
         api, host=host, port=int(port or 10101), stats=stats,
-        tls_cert=getattr(args, "tls_certificate", None)
-        or tls_cfg.get("certificate"),
-        tls_key=getattr(args, "tls_key", None) or tls_cfg.get("key"),
+        tls_cert=tls_cfg.get("certificate"),
+        tls_key=tls_cfg.get("key"),
         allowed_origins=origins)
     server.start()
     if join_needed:
@@ -275,11 +282,7 @@ def cmd_server(args):
         # reference's join loop does the same (gossip.go:116-140).
         import threading as _threading
 
-        tls_cfg_join = config.get("tls", {}) if isinstance(
-            config.get("tls", {}), dict) else {}
-        own_scheme = "https" if (
-            getattr(args, "tls_certificate", None)
-            or tls_cfg_join.get("certificate")) else "http"
+        own_scheme = "https" if tls_cfg.get("certificate") else "http"
 
         def _join():
             from .cluster import Node as _JNode
@@ -663,12 +666,29 @@ def _apply_server_flags(config, args):
     once via viper for every subcommand)."""
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
                  "replicas", "spmd_port", "long_query_time",
-                 "max_writes_per_request", "tracing"):
+                 "max_writes_per_request", "tracing", "workers"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
     if getattr(args, "spmd", False):
         config["spmd"] = True
+    # TLS and CORS live in config sub-tables ([tls], [handler]); fold the
+    # flags into those tables so `config` prints them where the server
+    # reads them (reference: server/config.go TLS + handler sections).
+    if getattr(args, "tls_certificate", None) is not None \
+            or getattr(args, "tls_key", None) is not None:
+        tls = config.get("tls")
+        if not isinstance(tls, dict):
+            tls = config["tls"] = {}
+        if getattr(args, "tls_certificate", None) is not None:
+            tls["certificate"] = args.tls_certificate
+        if getattr(args, "tls_key", None) is not None:
+            tls["key"] = args.tls_key
+    if getattr(args, "allowed_origins", None) is not None:
+        handler = config.get("handler")
+        if not isinstance(handler, dict):
+            handler = config["handler"] = {}
+        handler["allowed-origins"] = args.allowed_origins
     return config
 
 
@@ -794,6 +814,10 @@ def main(argv=None):
     p.add_argument("--allowed-origins", default=None,
                    help="comma-separated CORS origins browsers may query "
                         "from ('*' allows all); no CORS headers when unset")
+    p.add_argument("--workers", type=int, default=None,
+                   help="host-side worker pool size for per-shard fan-out "
+                        "(default min(32, cpu), env PILOSA_TPU_WORKERS; "
+                        "1 = serial execution)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")
@@ -872,6 +896,11 @@ def main(argv=None):
     p.add_argument("--spmd-port", type=int, default=None)
     p.add_argument("--long-query-time", default=None)
     p.add_argument("--max-writes-per-request", type=int, default=None)
+    p.add_argument("--tracing", default=None, choices=["none", "memory"])
+    p.add_argument("--tls-certificate", default=None)
+    p.add_argument("--tls-key", default=None)
+    p.add_argument("--allowed-origins", default=None)
+    p.add_argument("--workers", type=int, default=None)
     p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)

@@ -24,6 +24,7 @@ from ..exec.executor import ExecOptions, Executor
 from ..exec.result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount
 from ..pql import call_to_pql, parse
 from ..shardwidth import SHARD_WIDTH
+from ..utils.workpool import get_pool
 
 
 class ClusterExecError(Exception):
@@ -388,14 +389,13 @@ class ClusterExecutor:
                         remote=node.id != self.cluster.local_id):
                     run_node(node, node_shards)
 
-        threads = []
-        for node, node_shards in by_node.items():
-            t = threading.Thread(
-                target=run_node_traced, args=(node, node_shards))
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+        # Bounded fan-out on the shared worker pool (was an unbounded
+        # thread per node per query). run_node catches its own errors
+        # into `errors` and reduces as results arrive via merge_in, so
+        # the pool's fail-fast never triggers here and the
+        # reduce-as-they-arrive + replica-retry semantics are unchanged.
+        get_pool().map_ordered(
+            lambda item: run_node_traced(*item), list(by_node.items()))
 
         if errors:
             raise ClusterExecError(f"query failed: {errors}")
@@ -451,12 +451,8 @@ class ClusterExecutor:
                 pass
 
         if stale:
-            threads = [threading.Thread(target=fetch, args=(n,))
-                       for n in stale]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            # fetch() swallows its own errors, so pool fail-fast is inert
+            get_pool().map_ordered(fetch, stale)
         shards |= self.cluster.remote_available_shards(idx.name)
         return sorted(shards)
 

@@ -12,6 +12,13 @@ Reference: executor.go (Execute :113, executeCall :274, per-shard map fns
 - Cross-shard reduce runs on host (sums/merges), mirroring the reference's
   mapReduce tree but with shard-batched device work (the multi-device path
   in pilosa_tpu.parallel shard-maps the same evaluation over a mesh).
+- Per-shard fallback paths (trees the stacked evaluator can't cover) fan
+  their shard maps across the shared bounded worker pool
+  (utils/workpool.py — the reference's mapReduce worker pool,
+  executor.go:2455), reducing IN SHARD ORDER so every worker count gives
+  bit-identical results. Workers only issue single-device host/plane
+  work; multi-device launches stay behind the stacked evaluator's
+  process-wide dispatch lock.
 
 Aggregate semantics (baseValue clamping, notNull fast paths, sign handling)
 follow the reference exactly: executeRowBSIGroupShard executor.go:1533,
@@ -27,6 +34,7 @@ from ..core import timeq
 from ..core.view import VIEW_STANDARD
 from ..pql import Call, Condition, parse
 from ..shardwidth import SHARD_WIDTH, WORDS_PER_ROW
+from ..utils.workpool import shard_map_reduce
 from .result import FieldRow, GroupCount, Pair, RowIdentifiers, ValCount
 
 _TOPN_STACK_CHUNK = 256  # rows per stacked device batch
@@ -325,14 +333,16 @@ class Executor:
         import jax
 
         self.validate_bitmap_call(idx, call)
-        # Dispatch every shard's plane chain asynchronously, then fetch all
-        # result planes in ONE device->host transfer (the per-shard chains
-        # themselves never sync; see module docstring).
-        planes = []
-        for shard in self._call_shards(idx, shards):
-            plane = self.bitmap_call_shard(idx, call, shard)
-            if plane is not None:
-                planes.append((shard, plane))
+        # Dispatch every shard's plane chain asynchronously (fanned over
+        # the worker pool), then fetch all result planes in ONE
+        # device->host transfer (the per-shard chains themselves never
+        # sync; see module docstring).
+        shard_list = self._call_shards(idx, shards)
+        per_shard = shard_map_reduce(
+            shard_list, lambda shard: self.bitmap_call_shard(idx, call, shard))
+        planes = [(shard, plane)
+                  for shard, plane in zip(shard_list, per_shard)
+                  if plane is not None]
         row = Row()
         if planes:
             hosts = jax.device_get([p for _, p in planes])
@@ -591,11 +601,13 @@ class Executor:
         fast = self._stacked.try_count(idx, call.children[0], shard_list)
         if fast is not None:
             return fast
-        counts = []
-        for shard in shard_list:
+
+        def count_shard(shard):
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
-            if plane is not None:
-                counts.append(bitplane.popcount(plane))
+            return None if plane is None else bitplane.popcount(plane)
+
+        counts = [c for c in shard_map_reduce(shard_list, count_shard)
+                  if c is not None]
         if not counts:
             return 0
         # Host int sum: per-shard counts fit int32 (<= 2^20) but the total
@@ -643,18 +655,20 @@ class Executor:
         if fast is not None:
             total, count = fast
             return ValCount(total + opts.base * count, count)
-        per_shard = []
-        for shard in shard_list:
+        def sum_shard(shard):
             data = self._bsi_planes(field, shard)
             if data is None:
-                continue
+                return None
             planes, sign, exists = data
             has_filter, filt = self._sum_filter_planes(idx, call, shard)
             if has_filter and filt is None:
-                continue  # empty filter -> shard contributes nothing
+                return None  # empty filter -> shard contributes nothing
             if filt is None:
                 filt = jnp.full(WORDS_PER_ROW, 0xFFFFFFFF, dtype=jnp.uint32)
-            per_shard.append(bsi_ops.bsi_plane_counts(planes, sign, exists, filt))
+            return bsi_ops.bsi_plane_counts(planes, sign, exists, filt)
+
+        per_shard = [r for r in shard_map_reduce(shard_list, sum_shard)
+                     if r is not None]
         total, count = 0, 0
         for pos, negc, cnt in per_shard:
             pos = np.asarray(pos)
@@ -728,11 +742,15 @@ class Executor:
             if mag is None:
                 return ValCount()
             return ValCount(mag + field.options.base, count)
-        out = ValCount()
-        for shard in shard_list:
-            vc = self._minmax_shard(field, idx, call, shard, is_max)
-            out = out.larger(vc) if is_max else out.smaller(vc)
-        return out
+        # Ordered reduce: larger/smaller tie-breaking is order-sensitive,
+        # so the pool's shard-order reduction is what keeps every worker
+        # count bit-identical to the serial loop.
+        return shard_map_reduce(
+            shard_list,
+            lambda shard: self._minmax_shard(field, idx, call, shard, is_max),
+            reducer=lambda out, vc: out.larger(vc) if is_max
+            else out.smaller(vc),
+            initial=ValCount())
 
     def _set_field(self, idx, call):
         field_name = call.args.get("field") or call.args.get("_field")
@@ -756,17 +774,19 @@ class Executor:
         field = self._set_field(idx, call)
         if call.children:
             self.validate_bitmap_call(idx, call.children[0])
-        best = None  # (row_id, count)
-        for shard in self._call_shards(idx, shards):
+
+        def shard_best(shard):
+            """This shard's first non-empty row in direction order (the
+            serial loop stopped at it regardless of the global best)."""
             view = field.view(VIEW_STANDARD)
             frag = view.fragment(shard) if view else None
             if frag is None:
-                continue
+                return None
             filt = None
             if call.children:
                 filt = self.bitmap_call_shard(idx, call.children[0], shard)
                 if filt is None:
-                    continue
+                    return None
             for row_id in (reversed(frag.row_ids()) if is_max
                            else frag.row_ids()):
                 plane = frag.row_device(row_id)
@@ -774,12 +794,22 @@ class Executor:
                     plane = bitplane.intersect(plane, filt)
                 cnt = int(bitplane.popcount(plane))
                 if cnt > 0:
-                    if best is None or (is_max and row_id > best[0]) or \
-                            (not is_max and row_id < best[0]):
-                        best = (row_id, cnt)
-                    elif row_id == best[0]:
-                        best = (row_id, best[1] + cnt)
-                    break
+                    return (row_id, cnt)
+            return None
+
+        def merge(best, cand):
+            if cand is None:
+                return best
+            row_id, cnt = cand
+            if best is None or (is_max and row_id > best[0]) or \
+                    (not is_max and row_id < best[0]):
+                return (row_id, cnt)
+            if row_id == best[0]:
+                return (row_id, best[1] + cnt)
+            return best
+
+        best = shard_map_reduce(
+            self._call_shards(idx, shards), shard_best, reducer=merge)
         if best is None:
             return Pair(0, 0)
         return Pair(best[0], best[1])
@@ -876,12 +906,19 @@ class Executor:
         view = field.view(VIEW_STANDARD)
         if view is None:
             return totals
-        for shard in shard_list:
+        keys = list(totals)
+
+        def shard_counts(shard):
             frag = view.fragment(shard)
             if frag is None:
+                return None
+            return [frag.row_count(r) for r in keys]
+
+        for counts in shard_map_reduce(shard_list, shard_counts):
+            if counts is None:
                 continue
-            for r in totals:
-                totals[r] += frag.row_count(r)
+            for r, c in zip(keys, counts):
+                totals[r] += c
         return totals
 
     def _count_of(self, idx, call, shard_list):
@@ -892,12 +929,16 @@ class Executor:
         fast = self._stacked.try_count(idx, call, shard_list)
         if fast is not None:
             return fast
-        total = 0
-        for shard in shard_list:
+
+        def count_one(shard):
             plane = self.bitmap_call_shard(idx, call, shard)
-            if plane is not None:
-                total += int(bitplane.popcount(plane))
-        return total
+            if plane is None:
+                return 0
+            return int(bitplane.popcount(plane))
+
+        return shard_map_reduce(
+            shard_list, count_one,
+            reducer=lambda acc, c: acc + c, initial=0)
 
     def _candidate_rows(self, field, shard_list, restrict_ids, use_cache,
                         view_name):
@@ -906,12 +947,17 @@ class Executor:
         view = field.view(view_name)
         if view is None:
             return []
-        rows = set()
-        for shard in shard_list:
+
+        def shard_rows(shard):
             frag = view.fragment(shard)
             if frag is None:
-                continue
-            rows.update(fragment_topn_candidates(frag, use_cache))
+                return None
+            return fragment_topn_candidates(frag, use_cache)
+
+        rows = set()
+        for cand in shard_map_reduce(shard_list, shard_rows):
+            if cand is not None:
+                rows.update(cand)
         if restrict_ids is not None:
             wanted = {int(r) for r in restrict_ids}
             rows &= wanted
@@ -959,25 +1005,33 @@ class Executor:
         candidates = self._candidate_rows(
             field, shard_list, restrict_ids, use_cache, view_name)
         totals = {}
-        pending = []  # (row_ids_chunk, device_counts)
-        for shard in shard_list:
+
+        def shard_chunks(shard):
+            """Per-shard chunked device popcounts (single-device ops only;
+            safe to issue concurrently from pool workers)."""
             view = field.view(view_name)
             frag = view.fragment(shard) if view else None
             if frag is None:
-                continue
+                return []
             filt = None
             if filter_call is not None:
                 filt = self.bitmap_call_shard(idx, filter_call, shard)
                 if filt is None:
-                    continue  # empty filter -> zero counts in this shard
+                    return []  # empty filter -> zero counts in this shard
             present = set(frag.row_ids())
             row_ids = [r for r in candidates if r in present]
+            out = []
             for i in range(0, len(row_ids), _TOPN_STACK_CHUNK):
                 chunk = row_ids[i:i + _TOPN_STACK_CHUNK]
                 stack = jnp.stack([frag.row_device(r) for r in chunk])
                 if filt is not None:
                     stack = stack & filt[None, :]
-                pending.append((chunk, bitplane.popcount_rows(stack)))
+                out.append((chunk, bitplane.popcount_rows(stack)))
+            return out
+
+        pending = [pc for per_shard in
+                   shard_map_reduce(shard_list, shard_chunks)
+                   for pc in per_shard]
         for chunk, dev_counts in pending:
             host = np.asarray(dev_counts)
             for r, c in zip(chunk, host):
@@ -1033,18 +1087,21 @@ class Executor:
             view = field.view(view_name)
             if view is None:
                 continue
-            for shard in shard_list:
+
+            def shard_rows(shard, view=view):
                 frag = view.fragment(shard)
                 if frag is None:
-                    continue
+                    return None
                 if column is not None:
                     if column // SHARD_WIDTH != shard:
-                        continue
-                    for r in frag.row_ids():
-                        if frag.contains(r, column):
-                            rows.add(r)
-                else:
-                    rows.update(frag.row_ids())
+                        return None
+                    return {r for r in frag.row_ids()
+                            if frag.contains(r, column)}
+                return set(frag.row_ids())
+
+            for found in shard_map_reduce(shard_list, shard_rows):
+                if found is not None:
+                    rows.update(found)
         out = sorted(rows)
         if previous is not None:
             out = [r for r in out if r > previous]
@@ -1188,25 +1245,22 @@ class Executor:
         from ..ops import bitplane
         import jax.numpy as jnp
 
-        totals = {}
-        for shard in shard_list:
+        def shard_totals(shard):
+            """This shard's group -> count map (single-device intersect
+            chains + one host sync; independent across shards)."""
             frag_rows = []
-            ok = True
             for field, rows in zip(fields, child_rows):
                 view = field.view(VIEW_STANDARD)
                 frag = view.fragment(shard) if view else None
                 if frag is None:
-                    ok = False
-                    break
+                    return None
                 present = set(frag.row_ids())
                 frag_rows.append((frag, [r for r in rows if r in present]))
-            if not ok:
-                continue
             filt = None
             if filter_call is not None:
                 filt = self.bitmap_call_shard(idx, filter_call, shard)
                 if filt is None:
-                    continue
+                    return None
 
             # depth-first cross product with early pruning on empty planes
             pending = []
@@ -1223,12 +1277,21 @@ class Executor:
                         recurse(level + 1, combined, prefix + (row_id,))
 
             recurse(0, filt, ())
+            out = {}
             if pending:
                 groups, dev_counts = zip(*pending)
                 host = np.asarray(jnp.stack(list(dev_counts)))  # one sync
                 for group, c in zip(groups, host):
                     if int(c) > 0:
-                        totals[group] = totals.get(group, 0) + int(c)
+                        out[group] = out.get(group, 0) + int(c)
+            return out
+
+        totals = {}
+        for shard_counts in shard_map_reduce(shard_list, shard_totals):
+            if not shard_counts:
+                continue
+            for group, c in shard_counts.items():
+                totals[group] = totals.get(group, 0) + c
         return totals
 
     # -------------------------------------------------------------- Options
@@ -1317,10 +1380,14 @@ class Executor:
         for view_name, view in list(field.views.items()):
             if view_name.startswith("bsig_"):
                 continue
-            for shard in shard_list:
+
+            def clear_shard(shard, view=view):
                 frag = view.fragment(shard)
-                if frag is not None:
-                    changed |= bool(frag.set_row_plane(row_id, zeros))
+                if frag is None:
+                    return False
+                return bool(frag.set_row_plane(row_id, zeros))
+
+            changed |= any(shard_map_reduce(shard_list, clear_shard))
         return changed
 
     def _exec_store(self, idx, call, shards, opt):
@@ -1336,11 +1403,19 @@ class Executor:
             field = idx.create_field(field_name, FieldOptions())
         row_id = int(call.args[field_name])
         view = field.create_view_if_not_exists(VIEW_STANDARD)
-        changed = False
-        for shard in self._call_shards(idx, shards):
+
+        def gather_shard(shard):
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
-            host = (np.zeros(WORDS_PER_ROW, dtype=np.uint32)
+            return (np.zeros(WORDS_PER_ROW, dtype=np.uint32)
                     if plane is None else np.asarray(plane))
+
+        # Parallel read phase, then writes applied serially in shard
+        # order: create_fragment_if_not_exists mutates the view's
+        # fragment dict, which must not race.
+        shard_list = self._call_shards(idx, shards)
+        planes = shard_map_reduce(shard_list, gather_shard)
+        changed = False
+        for shard, host in zip(shard_list, planes):
             frag = view.create_fragment_if_not_exists(shard)
             changed |= bool(frag.set_row_plane(row_id, host))
         return changed

@@ -582,17 +582,28 @@ class StackedEvaluator:
     def _host_rows(self, view, row_ids, shards, pad=True):
         """Host [R, S_padded, W] uint32 gather of rows over shards
         (pad=False skips the device-multiple padding — patch gathers
-        address existing stack rows directly)."""
+        address existing stack rows directly).
+
+        The per-shard gathers fan out over the shared worker pool: each
+        task fills its own out[:, j] column (disjoint slices, so the
+        writes need no lock) and the numpy copies release the GIL. This
+        is the cold-build hot path — 954 shards × rows of one-at-a-time
+        copies before."""
+        from ..utils.workpool import get_pool
+
         n = self._padded_len(shards) if pad else len(shards)
         out = np.zeros((len(row_ids), n, WORDS_PER_ROW), dtype=np.uint32)
-        for j, shard in enumerate(shards):
-            frag = view.fragment(shard)
+
+        def gather_column(j):
+            frag = view.fragment(shards[j])
             if frag is None:
-                continue
+                return
             for i, row_id in enumerate(row_ids):
                 plane = frag.row_plane(row_id)
                 if plane is not None:
                     out[i, j] = np.asarray(plane)
+
+        get_pool().map_ordered(gather_column, range(len(shards)))
         self.planes_uploaded += len(row_ids) * len(shards)
         return out
 

@@ -174,6 +174,10 @@ class API:
     # instead (backpressure; a resize should finish long before a client
     # can push 10k batches).
     RESIZE_QUEUE_MAX = 10_000
+    # Replay attempts per queued write before it is dropped (transient
+    # peer errors heal; a write is only lost after all retries, counted
+    # in resize_replay_dropped).
+    RESIZE_REPLAY_RETRIES = 3
 
     def _queue_resize_write(self, kind, kwargs):
         """True = the write was queued for post-resize replay (caller
@@ -220,6 +224,41 @@ class API:
                 return
             self._resize_draining = True
 
+        from ..utils.stats import global_stats
+
+        def replay_one(kind, kwargs):
+            """Apply one queued write with bounded in-place retries.
+            Retrying IN PLACE (not re-queueing at the tail) is load-
+            bearing: replay order is arrival order, and a failed write
+            pushed behind later writes to the same bit could clobber a
+            newer acknowledged value. Only after the retries are
+            exhausted is the write dropped — that is the documented
+            crash-semantics loss, counted in resize_replay_dropped, not
+            a silent one."""
+            for attempt in range(self.RESIZE_REPLAY_RETRIES):
+                try:
+                    if kind == "bits":
+                        self.import_bits(**kwargs)
+                    else:
+                        self.import_values(**kwargs)
+                    return
+                except Exception:
+                    where = {k: kwargs[k] for k in
+                             ("index_name", "field_name")}
+                    if attempt + 1 < self.RESIZE_REPLAY_RETRIES:
+                        global_stats.count("resize_replay_retries")
+                        self.logger.printf(
+                            "resize write replay failed (attempt %d/%d, "
+                            "retrying): %s %r", attempt + 1,
+                            self.RESIZE_REPLAY_RETRIES, kind, where)
+                        time.sleep(0.2 * (2 ** attempt))
+                    else:
+                        global_stats.count("resize_replay_dropped")
+                        self.logger.printf(
+                            "resize write replay DROPPED after %d "
+                            "attempts: %s %r", self.RESIZE_REPLAY_RETRIES,
+                            kind, where)
+
         def replay():
             self._resize_replay_tls.active = True
             while True:
@@ -230,16 +269,7 @@ class API:
                         self._resize_draining = False
                         return
                 for kind, kwargs in queued:
-                    try:
-                        if kind == "bits":
-                            self.import_bits(**kwargs)
-                        else:
-                            self.import_values(**kwargs)
-                    except Exception:
-                        self.logger.printf(
-                            "resize write replay failed: %s %r", kind,
-                            {k: kwargs[k] for k in
-                             ("index_name", "field_name")})
+                    replay_one(kind, kwargs)
 
         threading.Thread(target=replay, daemon=True,
                          name="resize-write-drain").start()
@@ -916,9 +946,19 @@ class API:
         """Forget a remotely-advertised shard for a field (reference:
         api.DeleteAvailableShard api.go:1266 -> Field.RemoveAvailableShard
         field.go:513; used when a remote's shard advertisement turns out
-        stale). Our shard availability is tracked per-index in the
-        gossiped shard map, so removal drops the shard from every peer's
-        record for the index."""
+        stale).
+
+        DIVERGENCE from the reference: the reference tracks availability
+        per-FIELD (each field carries its own availableShards bitmap);
+        here availability is tracked per-INDEX in the gossiped shard map
+        (queries fan out by index, and a shard with any data in any
+        field has index data). So although this route accepts — and
+        validates — a field name for wire compatibility, removal drops
+        the shard from every peer's record for the WHOLE index, not just
+        the named field. Callers deleting a stale advertisement for one
+        field of a multi-field index remove it for the others too; the
+        next gossip push from the owning node restores it if any field
+        still has data. See docs/architecture.md ("Cluster")."""
         self._field(index_name, field_name)  # 404 on unknown index/field
         if self.cluster is not None:
             self.cluster.remove_remote_shard(index_name, int(shard))

@@ -533,6 +533,9 @@ class PilosaHTTPServer:
             out["stacked"] = local.stacked_stats()
         if self.api.spmd is not None:
             out["spmd"] = self.api.spmd.stats()
+        from ..utils import workpool
+
+        out["workpool"] = workpool.get_pool().stats()
         return RawResponse(_json.dumps(out).encode(), "application/json")
 
     def _get_debug_queries(self, req):

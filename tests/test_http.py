@@ -169,6 +169,19 @@ def test_metrics_endpoint(srv):
     data = c._request("GET", "/metrics")
     text = data.decode() if isinstance(data, bytes) else str(data)
     assert "pilosa_tpu_http_request_seconds_count" in text
+    # worker-pool gauges are registered at pool creation (zero before
+    # any job runs), so they are always present in the exposition
+    assert "pilosa_tpu_workpool_queue_depth" in text
+    assert "pilosa_tpu_workpool_busy_workers" in text
+
+
+def test_debug_vars_workpool(srv):
+    c = srv.client
+    out = c._request("GET", "/debug/vars")
+    wp = out["workpool"]
+    assert wp["workers"] >= 1
+    assert {"queue_depth", "busy_workers", "tasks", "jobs",
+            "inline_jobs", "errors"} <= set(wp)
 
 
 def test_time_quantum_over_http(srv):

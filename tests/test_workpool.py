@@ -1,0 +1,195 @@
+"""Worker pool unit tests: bounded concurrency, ordered results,
+fail-fast cancellation, and the workers=1 serial oracle."""
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.utils import workpool
+from pilosa_tpu.utils.workpool import WorkPool, shard_map_reduce
+
+
+def test_ordered_results_match_serial():
+    pool = WorkPool(workers=4)
+    try:
+        items = list(range(100))
+
+        def slow_square(x):
+            # de-correlate completion order from submission order
+            time.sleep(0.001 * (x % 7))
+            return x * x
+
+        assert pool.map_ordered(slow_square, items) == \
+            [x * x for x in items]
+    finally:
+        pool.shutdown()
+
+
+def test_bounded_concurrency():
+    workers = 3
+    pool = WorkPool(workers=workers)
+    try:
+        active = [0]
+        peak = [0]
+        lock = threading.Lock()
+
+        def task(_):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.01)
+            with lock:
+                active[0] -= 1
+
+        pool.map_ordered(task, range(30))
+        assert peak[0] <= workers
+        assert peak[0] > 1  # it did actually run concurrently
+    finally:
+        pool.shutdown()
+
+
+def test_error_propagates_and_cancels_queued():
+    """The first failure re-raises on the submitter and unclaimed tasks
+    never run: the failing task holds every worker's attention via an
+    Event so the count of tasks that ran afterwards is deterministic."""
+    workers = 2
+    pool = WorkPool(workers=workers)
+    try:
+        failed = threading.Event()
+        ran_after_error = [0]
+
+        def task(i):
+            if i == 0:
+                failed.set()
+                raise ValueError("boom")
+            # tasks claimed before the failure block until it happens;
+            # anything claimed after it would bump the counter
+            if failed.wait(timeout=5):
+                time.sleep(0.005)
+            if failed.is_set():
+                ran_after_error[0] += i > workers
+            return i
+
+        with pytest.raises(ValueError, match="boom"):
+            pool.map_ordered(task, range(50))
+        # at most the tasks already claimed when the error hit ran;
+        # the other ~47 were cancelled
+        assert ran_after_error[0] <= workers
+        assert pool.stats()["errors"] == 1
+    finally:
+        pool.shutdown()
+
+
+def test_workers_1_runs_inline_on_caller():
+    pool = WorkPool(workers=1)
+    try:
+        caller = threading.current_thread().ident
+        threads = pool.map_ordered(
+            lambda _: threading.current_thread().ident, range(10))
+        assert set(threads) == {caller}
+        assert pool._threads == []  # no threads were ever spawned
+        assert pool.stats()["inline_jobs"] == 1
+    finally:
+        pool.shutdown()
+
+
+def test_nested_submit_from_worker_runs_inline():
+    pool = WorkPool(workers=2)
+    try:
+        def inner(y):
+            return y + 1
+
+        def outer(x):
+            # a worker submitting to its own pool must not deadlock
+            return sum(pool.map_ordered(inner, range(x)))
+
+        assert pool.map_ordered(outer, range(8)) == \
+            [sum(y + 1 for y in range(x)) for x in range(8)]
+    finally:
+        pool.shutdown()
+
+
+def test_shard_map_reduce_ordered_reduce():
+    pool = WorkPool(workers=4)
+    try:
+        # string concat is order-sensitive: any reordering would differ
+        out = shard_map_reduce(
+            range(20), lambda x: str(x),
+            reducer=lambda acc, s: acc + s, initial="", pool=pool)
+        assert out == "".join(str(x) for x in range(20))
+        # no reducer -> the ordered result list
+        assert shard_map_reduce(range(5), lambda x: -x, pool=pool) == \
+            [0, -1, -2, -3, -4]
+    finally:
+        pool.shutdown()
+
+
+def test_serial_oracle_equivalence():
+    """workers=1 and workers=8 produce identical ordered results for an
+    order-sensitive fold."""
+    def mapper(x):
+        return (x * 7919) % 1000
+
+    serial = WorkPool(workers=1)
+    parallel = WorkPool(workers=8)
+    try:
+        items = list(range(200))
+        r1 = shard_map_reduce(items, mapper, pool=serial)
+        r8 = shard_map_reduce(items, mapper, pool=parallel)
+        assert r1 == r8
+    finally:
+        serial.shutdown()
+        parallel.shutdown()
+
+
+def test_shutdown_drains_queued_jobs():
+    """A job that raced into the queue around shutdown still completes
+    (inline on the shutting-down thread), so no submitter hangs."""
+    pool = WorkPool(workers=2)
+    pool.map_ordered(lambda x: x, range(4))  # spin the workers up
+    done = threading.Event()
+    results = []
+
+    def submit():
+        results.append(pool.map_ordered(lambda x: x * 2, range(20)))
+        done.set()
+
+    t = threading.Thread(target=submit)
+    t.start()
+    pool.shutdown()
+    assert done.wait(timeout=10), "submitter hung across shutdown"
+    t.join()
+    assert results == [[x * 2 for x in range(20)]]
+
+
+def test_configure_replaces_process_pool():
+    old = workpool.get_pool()
+    try:
+        p = workpool.configure(3)
+        assert workpool.get_pool() is p
+        assert workpool.worker_count() == 3
+        assert p.map_ordered(lambda x: x + 1, range(5)) == [1, 2, 3, 4, 5]
+    finally:
+        workpool.configure(old.workers)
+
+
+def test_default_workers_env(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_WORKERS", "5")
+    assert workpool.default_workers() == 5
+    monkeypatch.setenv("PILOSA_TPU_WORKERS", "nope")
+    assert workpool.default_workers() == min(32, __import__("os").cpu_count() or 1)
+    monkeypatch.setenv("PILOSA_TPU_WORKERS", "-2")
+    assert workpool.default_workers() == min(32, __import__("os").cpu_count() or 1)
+
+
+def test_gauges_and_stats_settle_to_zero():
+    pool = WorkPool(workers=4)
+    try:
+        pool.map_ordered(lambda x: x, range(64))
+        s = pool.stats()
+        assert s["queue_depth"] == 0
+        assert s["busy_workers"] == 0
+        assert s["tasks"] == 64
+    finally:
+        pool.shutdown()

@@ -1,0 +1,96 @@
+"""Concurrent-serving regression guard: the worker pool plus the stacked
+evaluator's dispatch lock must not wedge (PR 1's CPU-backend rendezvous
+fix). Mixed stacked fast-path and per-shard fallback queries hammer one
+executor from many client threads while the pool fans their shard work
+out; every thread must finish within the deadline with correct results."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import workpool
+
+N_SHARDS = 10
+CLIENTS = 6
+ROUNDS = 5
+DEADLINE = 120  # generous; a wedge hangs forever, not slowly
+
+
+@pytest.fixture
+def env(tmp_path):
+    h = Holder(str(tmp_path / "data"), use_snapshot_queue=False).open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    rng = np.random.RandomState(3)
+    rows, cols = [], []
+    for shard in range(N_SHARDS):
+        base = shard * SHARD_WIDTH
+        cs = rng.choice(5000, size=60, replace=False).astype(np.int64) + base
+        rows.extend(int(r) for r in rng.randint(1, 5, size=60))
+        cols.extend(int(c) for c in cs)
+    f.import_bits(rows, cols)
+    old = workpool._pool
+    pool = workpool.WorkPool(workers=8)
+    workpool._pool = pool
+    yield h, Executor(h)
+    workpool._pool = old
+    pool.shutdown()
+    h.close()
+
+
+def test_concurrent_stacked_and_fallback_no_wedge(env):
+    h, e = env
+    # one serial pass fixes the expected answers (and warms nothing: the
+    # stacked caches rebuild under contention below, which is the point)
+    expected = {
+        "Count(Row(f=1))": e.execute("i", "Count(Row(f=1))")[0],
+        "Count(Union(Row(f=1), Row(f=2)))":
+            e.execute("i", "Count(Union(Row(f=1), Row(f=2)))")[0],
+        "TopN(f, n=2)": e.execute("i", "TopN(f, n=2)")[0],
+        "GroupBy(Rows(f))": e.execute("i", "GroupBy(Rows(f))")[0],
+    }
+    # a second executor so both a warm and a cold stacked cache serve
+    # concurrently (cold builds take the gather + dispatch-lock path)
+    e2 = Executor(h)
+
+    errors = []
+    barrier = threading.Barrier(CLIENTS)
+
+    def client(k):
+        ex = e if k % 2 == 0 else e2
+        try:
+            barrier.wait(timeout=30)
+            for _ in range(ROUNDS):
+                for q, want in expected.items():
+                    got = ex.execute("i", q)[0]
+                    if got != want:
+                        errors.append((q, want, got))
+        except Exception as exc:  # noqa: BLE001 — reported via errors
+            errors.append(("exception", k, repr(exc)))
+
+    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+               for k in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=DEADLINE)
+    wedged = [t.name for t in threads if t.is_alive()]
+    assert not wedged, f"serving threads wedged: {wedged}"
+    assert not errors, f"concurrent serving diverged: {errors[:3]}"
+
+
+def test_concurrent_queries_through_pool_workers(env):
+    """Queries submitted FROM pool workers (cluster fan-out shape: a
+    node task runs the local executor, whose shard loops then submit to
+    the same pool) complete inline without deadlock."""
+    h, e = env
+    pool = workpool.get_pool()
+    count = e.execute("i", "Count(Row(f=1))")[0]
+
+    out = pool.map_ordered(
+        lambda _: e.execute("i", "Count(Row(f=1))")[0], range(12))
+    assert out == [count] * 12
