@@ -14,9 +14,9 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_bench_orchestrator.py
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
-	lint bench-cpu
+	test-flightrec lint bench-cpu
 
-test: test-core test-distributed
+test: test-core test-distributed test-flightrec
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -24,6 +24,11 @@ test-core:
 
 test-distributed:
 	$(PY) -m pytest $(DISTRIBUTED) $(PYTEST_FLAGS)
+
+# Black-box surface: flight recorder ring, stall watchdog, HBM ledger
+# exactness, kernel attribution, and the /debug endpoints serving them.
+test-flightrec:
+	$(PY) -m pytest tests/test_flightrec.py $(PYTEST_FLAGS)
 
 # Query observability surface: per-query profiles, histograms, the
 # slow-query log, trace retention, and the exposition formats.

@@ -288,7 +288,25 @@ def main():
 # PILOSA_TPU_BENCH_FAKE (child stub: ok|error|hang|hang_after_probe).
 
 PROBE_MARKER = "__PILOSA_BENCH_PROBE_OK__"
+DEBUG_MARKER = "__PILOSA_BENCH_DEBUG__:"
 _CHILD_ENV = "PILOSA_TPU_BENCH_CHILD"
+
+
+def _announce_debug_server() -> None:
+    """Start the in-process flight-recorder HTTP endpoint and tell the
+    parent its port (stderr marker). When a child later wedges, the
+    parent fetches the recorder tail over localhost BEFORE killing it —
+    the black box survives the crash. Never fatal: the bench must not
+    die because a debug port could not bind."""
+    try:
+        from pilosa_tpu.utils import flightrec
+
+        srv = flightrec.start_debug_server()
+        flightrec.record("bench.child_start", pid=os.getpid())
+        print(f"{DEBUG_MARKER}{srv.server_address[1]}",
+              file=sys.stderr, flush=True)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _child() -> None:
@@ -299,6 +317,7 @@ def _child() -> None:
     if fake:
         _child_fake(fake)
         return
+    _announce_debug_server()
     import jax
     import jax.numpy as jnp
 
@@ -320,6 +339,7 @@ def _child_fake(mode: str) -> None:
     before the probe, like a tunnel import blowing up) | tpu_hang
     (hangs unless the parent retargeted it at cpu — exercises the
     cpu-fallback leg)."""
+    _announce_debug_server()
     if mode == "crash":
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0, "error": "fake crash"}))
@@ -377,13 +397,37 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
 
     probe_ok = threading.Event()
     out_lines: list = []
+    debug_port: list = [None]  # child flightrec port (stderr marker)
 
     def pump_err():
         for line in proc.stderr:
             if PROBE_MARKER in line:
                 probe_ok.set()
+            elif DEBUG_MARKER in line:
+                try:
+                    debug_port[0] = int(
+                        line.split(DEBUG_MARKER, 1)[1].strip())
+                except ValueError:
+                    pass
             else:
                 sys.stderr.write(line)
+
+    def fetch_flightrec():
+        """Pull the child's recorder tail over localhost (called BEFORE
+        kill — the ring dies with the process). Best-effort, bounded."""
+        if debug_port[0] is None:
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{debug_port[0]}/debug/flightrecorder",
+                    timeout=2) as resp:
+                snap = json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — the child may be truly wedged
+            return None
+        snap["events"] = snap.get("events", [])[-40:]
+        return snap
 
     def pump_out():
         for line in proc.stdout:
@@ -398,25 +442,28 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         """Kill the child and salvage its last JSON record: a child that
         printed an error line before wedging (partial run, OOM handler,
         device fault) still gets its real failure into last_err instead
-        of an anonymous None."""
+        of an anonymous None. The flight-recorder tail is fetched first —
+        it is the only record of what the child was doing when it hung."""
         print(f"bench: killing attempt ({reason})", file=sys.stderr,
               flush=True)
+        phase = "main" if probe_ok.is_set() else "probe"
+        tail = fetch_flightrec()
         proc.kill()
         proc.wait()
         te.join(timeout=5)
         to.join(timeout=5)
         rec = _last_record(out_lines)
-        if rec is None:
-            return {"metric": "error", "value": 0, "unit": "",
-                    "vs_baseline": 0,
-                    "error": f"bench child killed: {reason}"}
-        if rec.get("metric") != "error":
+        if rec is None or rec.get("metric") != "error":
+            detail = "" if rec is None \
+                else f" (last record: {rec.get('metric')})"
             # a partial measurement from a killed child is not a result
-            return {"metric": "error", "value": 0, "unit": "",
-                    "vs_baseline": 0,
-                    "error": f"bench child killed: {reason} "
-                             f"(last record: {rec.get('metric')})"}
+            rec = {"metric": "error", "value": 0, "unit": "",
+                   "vs_baseline": 0,
+                   "error": f"bench child killed: {reason}{detail}"}
         rec.setdefault("error", f"bench child killed: {reason}")
+        rec["phase"] = phase
+        if tail is not None:
+            rec["flightrec"] = tail
         return rec
 
     t0 = time.perf_counter()
@@ -448,9 +495,12 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
     rec = _last_record(out_lines)
     if rec is None and exited_early:
         return {"metric": "error", "value": 0, "unit": "",
-                "vs_baseline": 0,
+                "vs_baseline": 0, "phase": "probe",
                 "error": f"bench child exited rc={proc.returncode} "
                          "before probe (no JSON record)"}
+    if rec is not None and rec.get("metric") == "error":
+        rec.setdefault(
+            "phase", "main" if probe_ok.is_set() else "probe")
     return rec
 
 
@@ -479,6 +529,7 @@ def orchestrate() -> None:
     t0 = time.perf_counter()
     last_err = None
     attempts_made = 0
+    attempt_log = []  # per-attempt forensics for the final error record
     for attempt in range(attempts):
         remaining = budget - (time.perf_counter() - t0)
         if remaining < 30:
@@ -493,6 +544,14 @@ def orchestrate() -> None:
             return
         if rec is not None:
             last_err = rec
+            attempt_log.append({
+                "attempt": attempts_made,
+                "phase": rec.get("phase"),
+                "reason": rec.get("error"),
+            })
+        else:
+            attempt_log.append({"attempt": attempts_made, "phase": None,
+                                "reason": "no JSON record from child"})
         time.sleep(2.0)
     # Every device-tunnel probe died. A bare error line tells BENCH
     # readers nothing about the code's health — take one LABELED cpu
@@ -519,12 +578,20 @@ def orchestrate() -> None:
             return
         if rec is not None:
             last_err = rec
+            attempt_log.append({"attempt": "cpu-fallback",
+                                "phase": rec.get("phase"),
+                                "reason": rec.get("error")})
     timer.cancel()
-    print(json.dumps(last_err or {
+    final = last_err or {
         "metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
         "error": "bench: all attempts missed the probe/full deadline "
                  "(device tunnel hung?)",
-    }), flush=True)
+    }
+    # Forensics: which phase each attempt died in, and the last child's
+    # flight-recorder tail — so BENCH_r{N}.json explains the wedge
+    # instead of shrugging at it.
+    final["attempts"] = attempt_log
+    print(json.dumps(final), flush=True)
     sys.exit(1)
 
 

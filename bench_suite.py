@@ -720,6 +720,116 @@ def bench_workpool_scaling():
     _close(holder)
 
 
+# ---------------------------------------------------------------- config 8
+
+def bench_flightrec_overhead():
+    """Flight recorder + HBM ledger + watchdog acceptance leg.
+
+    Two claims, one JSON line:
+    1. The always-on black box (2 ring appends + watchdog probe +
+       kernel attribution per dispatch; ledger updates on cache put)
+       costs <2% of an api_nop query — asserted via the same
+       microbenchmark style as the groupby_pairwise profiling gate
+       (per-dispatch cost x dispatches-per-query / query wall), which
+       is stable where an enabled-vs-disabled wall-clock diff drowns
+       in scheduler noise. Both wall clocks are still published.
+    2. A synthetic stuck dispatch (holding _DISPATCH_LOCK past the
+       deadline) trips the watchdog within deadline + one poll, with
+       the stall recorded in the ring.
+    """
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import flightrec
+
+    platform, holder, api, ex = _env()
+    api.create_index("fr")
+    api.create_field("fr", "a")
+    api.create_field("fr", "b")
+    idx = holder.index("fr")
+    n_shards = 4 if platform != "cpu" else 2
+    rng = np.random.default_rng(23)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=100_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    idx.field("b").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+
+    api.executor = ex
+    st = ex._stacked
+    pql = "Count(Intersect(Row(a=1), Row(b=1)))"
+    api.query("fr", pql)  # warm stacks + compile
+
+    n_q = 50 if platform == "cpu" else 200
+    d0 = st.cache_stats()
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("fr", pql)
+    enabled_ms = (time.perf_counter() - t0) / n_q * 1000
+    d1 = st.cache_stats()
+    disp_per_q = max(1, (d1["dispatches"] - d0["dispatches"]) // n_q)
+
+    # per-dispatch instrumentation microbenchmark: exactly what
+    # _locked_dispatch adds (2 records + watch probe + _note_kernel)
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        flightrec.record("dispatch.start", kernel="bench_probe")
+        flightrec.watch_end(flightrec.watch_begin("bench_probe"))
+        st._note_kernel("bench_probe", 0.0, 0, 0)
+        flightrec.record("dispatch.end", kernel="bench_probe")
+    per_dispatch_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = per_dispatch_ns * disp_per_q / 1e6 / enabled_ms * 100
+    assert overhead_pct < 2.0, (
+        f"flight recorder + attribution costs {overhead_pct:.3f}% of an "
+        "api_nop query — no longer an always-on-safe default")
+
+    # disabled-recorder wall clock (informational: the delta is noise
+    # compared to the asserted microbenchmark)
+    flightrec.configure(0)
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("fr", pql)
+    disabled_ms = (time.perf_counter() - t0) / n_q * 1000
+    flightrec.configure(flightrec.DEFAULT_RING_SIZE)
+
+    # synthetic stuck dispatch: hold the dispatch lock past the deadline
+    deadline = 0.15
+    wd = flightrec.configure_watchdog(deadline)
+    detect_s = None
+    t0 = time.perf_counter()
+    with st._locked_dispatch("synthetic_stall"):
+        while time.perf_counter() - t0 < deadline * 10:
+            if wd.stalls:
+                detect_s = time.perf_counter() - t0
+                break
+            time.sleep(0.005)
+    flightrec.stop_watchdog()
+    assert detect_s is not None, (
+        f"watchdog never tripped on a dispatch stuck {deadline * 10}s "
+        f"past a {deadline}s deadline")
+    assert detect_s <= deadline + 4 * wd.poll_interval + 0.1, (
+        f"watchdog tripped after {detect_s:.3f}s — deadline {deadline}s "
+        f"+ poll {wd.poll_interval}s")
+    stall_events = [e for e in flightrec.snapshot()["events"]
+                    if e["kind"] == "watchdog.stall"]
+    assert stall_events, "stall tripped but no watchdog.stall event"
+
+    hbm = st.hbm_snapshot(top=5)
+    _close(holder)
+    _emit("flightrec_overhead_pct", overhead_pct, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "dispatches_per_q": disp_per_q,
+        "per_dispatch_instrumentation_ns": round(per_dispatch_ns, 1),
+        "api_nop_enabled_ms": round(enabled_ms, 3),
+        "api_nop_disabled_ms": round(disabled_ms, 3),
+        "overhead_pct": round(overhead_pct, 4),
+        "watchdog_deadline_s": deadline,
+        "watchdog_detect_s": round(detect_s, 3),
+        "watchdog_stalls": wd.stalls,
+        "hbm_total_bytes": hbm["total_bytes"],
+        "hbm_entries": len(hbm["entries"])})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -728,6 +838,7 @@ CONFIGS = {
     "golden_cluster": bench_golden_cluster,
     "groupby_pairwise": bench_groupby_pairwise,
     "workpool_scaling": bench_workpool_scaling,
+    "flightrec_overhead": bench_flightrec_overhead,
 }
 
 

@@ -233,6 +233,21 @@ def cmd_server(args):
         stats, interval=parse_duration(
             config.get("metric-poll-interval", "10s"))).start()
 
+    # Black-box flight recorder + stall watchdog + crash stack dumps.
+    # The recorder defaults on (bounded ring, negligible cost); the
+    # watchdog only runs when a deadline is configured.
+    from .utils import flightrec as _flightrec
+    from .utils.logger import StandardLogger as _FrLogger
+
+    frs = config.get("flight-recorder-size")
+    if frs is not None:
+        _flightrec.configure(int(frs))
+    wd_deadline = config.get("watchdog-deadline")
+    if wd_deadline:
+        _flightrec.configure_watchdog(
+            parse_duration(str(wd_deadline)), logger=_FrLogger())
+    _flightrec.install_crash_handler(logger=_FrLogger())
+
     # Trace retention (GET /debug/traces): "memory" installs a bounded
     # InMemoryTracer ring; the default keeps the nop tracer, whose hot
     # path allocates no spans at all (query profiles via ?profile=true /
@@ -348,6 +363,7 @@ def cmd_server(args):
     finally:
         if diagnostics:
             diagnostics.stop()
+        _flightrec.stop_watchdog()
         runtime_monitor.stop()
         if translate_repl:
             translate_repl.stop()
@@ -666,7 +682,8 @@ def _apply_server_flags(config, args):
     once via viper for every subcommand)."""
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
                  "replicas", "spmd_port", "long_query_time",
-                 "max_writes_per_request", "tracing", "workers"):
+                 "max_writes_per_request", "tracing", "workers",
+                 "flight_recorder_size", "watchdog_deadline"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -818,6 +835,13 @@ def main(argv=None):
                    help="host-side worker pool size for per-shard fan-out "
                         "(default min(32, cpu), env PILOSA_TPU_WORKERS; "
                         "1 = serial execution)")
+    p.add_argument("--flight-recorder-size", type=int, default=None,
+                   help="flight-recorder ring capacity in events "
+                        "(default 2048; 0 disables recording)")
+    p.add_argument("--watchdog-deadline", default=None,
+                   help="stall watchdog deadline (e.g. 30s, 2m): dump "
+                        "stacks + recorder tail when a dispatch or query "
+                        "runs past it; disabled when unset")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")
@@ -901,6 +925,8 @@ def main(argv=None):
     p.add_argument("--tls-key", default=None)
     p.add_argument("--allowed-origins", default=None)
     p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--flight-recorder-size", type=int, default=None)
+    p.add_argument("--watchdog-deadline", default=None)
     p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)

@@ -14,6 +14,7 @@ control plane (node-event messages), not via discovery.
 
 import threading
 
+from ..utils import flightrec
 from .node import NODE_STATE_DOWN, NODE_STATE_READY
 
 
@@ -60,6 +61,7 @@ class HealthMonitor:
             self._failures[node.id] = 0
             if node.state == NODE_STATE_DOWN:
                 self.cluster.set_node_state(node.id, NODE_STATE_READY)
+                flightrec.record("cluster.node_up", node=node.id)
                 if self.on_change:
                     self.on_change(node, NODE_STATE_READY)
         else:
@@ -67,6 +69,8 @@ class HealthMonitor:
             self._failures[node.id] = n
             if n >= self.confirm_retries and node.state != NODE_STATE_DOWN:
                 self.cluster.set_node_state(node.id, NODE_STATE_DOWN)
+                flightrec.record("cluster.node_down", node=node.id,
+                                 failures=n)
                 if self.on_change:
                     self.on_change(node, NODE_STATE_DOWN)
 

@@ -24,6 +24,7 @@ import logging
 import threading
 import uuid
 
+from ..utils import flightrec
 from .broadcast import MessageType, Serializer
 from .node import CLUSTER_STATE_NORMAL, CLUSTER_STATE_RESIZING, Node
 
@@ -147,6 +148,9 @@ class ResizeManager:
             job = ResizeJob(uuid.uuid4().hex[:12], action, old_nodes,
                             new_nodes, instructions)
             self.job = job
+            flightrec.record("cluster.resize_begin", job=job.id,
+                             action=action, node=node.id,
+                             instructions=len(instructions))
 
             # Block queries BEFORE the new placement becomes visible, so
             # no request routes by the new topology while data is moving.
@@ -173,6 +177,7 @@ class ResizeManager:
     def _revert(self, job, state):
         """Restore the pre-resize topology (abort/failure path)."""
         job.state = state
+        flightrec.record("cluster.resize_abort", job=job.id, state=state)
         self.cluster.nodes = sorted(job.old_nodes, key=lambda n: n.id)
         self.cluster.state = CLUSTER_STATE_NORMAL
         self.cluster.save_topology()
@@ -264,6 +269,8 @@ class ResizeManager:
         # DONE only after peers were told NORMAL: a client that polls
         # status DONE must not then hit a follower still rejecting queries
         job.state = "DONE"
+        flightrec.record("cluster.resize_finalize", job=job.id,
+                         action=job.action, nodes=len(job.new_nodes))
         if self.on_state_normal:
             self.on_state_normal()
         if self.on_complete:

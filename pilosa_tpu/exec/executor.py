@@ -199,6 +199,14 @@ class Executor:
         """Stack-cache observability snapshot (see StackedEvaluator)."""
         return self._stacked.cache_stats()
 
+    def hbm_stats(self, top=50):
+        """HBM ledger snapshot (see StackedEvaluator.hbm_snapshot)."""
+        return self._stacked.hbm_snapshot(top=top)
+
+    def kernel_stats(self, include_costs=True):
+        """Per-kernel attribution (see StackedEvaluator.kernels_snapshot)."""
+        return self._stacked.kernels_snapshot(include_costs=include_costs)
+
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name, query, shards=None, options=None):

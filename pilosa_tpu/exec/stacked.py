@@ -36,8 +36,10 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils import flightrec as _flightrec
 from ..utils import profile as _profile
 from ..utils import tracing as _tracing
+from ..utils.stats import global_stats
 
 
 class GroupCommit:
@@ -355,6 +357,24 @@ class StackedEvaluator:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Per-pool eviction counters tagged by cause ("budget" LRU
+        # pressure vs "invalidate" full flushes), mirrored to /metrics as
+        # stacked_evictions_total{pool,cause}; the untagged total above
+        # stays for back-compat with older dashboards.
+        self.pool_evictions = {}
+        # HBM ledger: resident stack-cache bytes attributed per
+        # (index, field, pool), maintained exactly in lockstep with
+        # _stack_bytes/_rows_stack_bytes by _cache_put/invalidate and
+        # exported as hbm_stack_bytes{index,field,pool} gauges +
+        # GET /debug/hbm. Answers "what is resident in HBM and for whom".
+        self._hbm_ledger = {}
+        # Per-kernel attribution: kind -> {count, seconds, bytes_in,
+        # bytes_out} fed by _locked_dispatch; arg shape specs captured on
+        # each compiled fn's first call so /debug/kernels can compute
+        # jax cost_analysis() lazily (never on the serving path).
+        self._kernels = {}
+        self._fn_specs = {}
+        self._kernel_costs = {}
         # Incremental-maintenance observability: a patch re-uploads only
         # the drifted shards' planes instead of the whole stack; tests
         # assert planes_uploaded stays O(changed shards) under writes.
@@ -490,6 +510,7 @@ class StackedEvaluator:
             hit = pool.get(key)
             if hit is not None and hit[3] == stamp:
                 pool.move_to_end(key)
+                hit[4] = time.time()  # last-hit age for /debug/hbm
                 self.hits += 1
                 return hit[1]
         return None
@@ -507,14 +528,46 @@ class StackedEvaluator:
                 pool.move_to_end(key)
                 if stamp is not None:
                     hit[3] = stamp
+                hit[4] = time.time()
                 self.hits += 1
                 return hit[1]
             self.misses += 1
         return None
 
+    def _ledger_key(self, key):
+        """Every cache key carries (kind, index, field, ...) at positions
+        0-2; the ledger attributes bytes per (index, field, pool)."""
+        pool_name = "rows" if key[0] == "rows" else "stack"
+        return (key[1], key[2], pool_name)
+
+    def _ledger_add(self, key, delta):
+        """Move the HBM ledger in lockstep with the pool byte counters
+        (caller holds self._lock). Gauges update here too: puts/evicts
+        are cache-fill events, not per-query hot path."""
+        lkey = self._ledger_key(key)
+        new = self._hbm_ledger.get(lkey, 0) + delta
+        if new <= 0:
+            self._hbm_ledger.pop(lkey, None)
+            new = 0
+        else:
+            self._hbm_ledger[lkey] = new
+        index, field, pool_name = lkey
+        global_stats.gauge("hbm_stack_bytes", new, {
+            "index": index, "field": field, "pool": pool_name})
+
+    def _count_eviction(self, pool_name, cause, n=1):
+        """Per-pool, cause-tagged eviction counters (caller holds
+        self._lock); exported as stacked_evictions_total{pool,cause}."""
+        k = (pool_name, cause)
+        self.pool_evictions[k] = self.pool_evictions.get(k, 0) + n
+        global_stats.count("stacked_evictions", n,
+                           {"pool": pool_name, "cause": cause})
+
     def _cache_put(self, key, gens, arrays, nbytes, stamp=None):
         pool, budget = self._pool(key)
         rows = pool is self._rows_stacks
+        pool_name = "rows" if rows else "stack"
+        evicted_keys = []
         with self._lock:
             old = pool.pop(key, None)
             if old is not None:
@@ -522,19 +575,32 @@ class StackedEvaluator:
                     self._rows_stack_bytes -= old[2]
                 else:
                     self._stack_bytes -= old[2]
-            pool[key] = [gens, arrays, nbytes, stamp]
+                self._ledger_add(key, -old[2])
+            pool[key] = [gens, arrays, nbytes, stamp, time.time()]
+            self._ledger_add(key, nbytes)
             if rows:
                 self._rows_stack_bytes += nbytes
                 while self._rows_stack_bytes > budget and len(pool) > 1:
-                    _, evicted = pool.popitem(last=False)
+                    ekey, evicted = pool.popitem(last=False)
                     self._rows_stack_bytes -= evicted[2]
                     self.evictions += 1
+                    self._ledger_add(ekey, -evicted[2])
+                    self._count_eviction(pool_name, "budget")
+                    evicted_keys.append((ekey, evicted[2]))
             else:
                 self._stack_bytes += nbytes
                 while self._stack_bytes > budget and len(pool) > 1:
-                    _, evicted = pool.popitem(last=False)
+                    ekey, evicted = pool.popitem(last=False)
                     self._stack_bytes -= evicted[2]
                     self.evictions += 1
+                    self._ledger_add(ekey, -evicted[2])
+                    self._count_eviction(pool_name, "budget")
+                    evicted_keys.append((ekey, evicted[2]))
+        _flightrec.record("cache.put", pool=pool_name, index=key[1],
+                          field=key[2], bytes=nbytes)
+        for ekey, ebytes in evicted_keys:
+            _flightrec.record("cache.evict", pool=pool_name, index=ekey[1],
+                              field=ekey[2], bytes=ebytes, cause="budget")
 
     def leaf_stack(self, idx, field_name, row_id, shards):
         """Cached [S, W] device stack of one row over `shards`."""
@@ -765,7 +831,10 @@ class StackedEvaluator:
             return None
         planes, sign, exists = data
         self.dispatches += 1
-        with self._locked_dispatch("bsi_condition"):
+        with self._locked_dispatch(
+                "bsi_condition",
+                nbytes_in=(planes.size + sign.size + exists.size) * 4,
+                nbytes_out=sign.size * 4):
             return _launch_barrier(
                 apply_bsi_condition(plan, planes, sign, exists))
 
@@ -797,7 +866,10 @@ class StackedEvaluator:
         # the evaluator's own union fold: one fn-cache, one operator impl
         sig = ("|", tuple(("leaf", i) for i in range(len(stacks))))
         self.dispatches += 1
-        with self._locked_dispatch("time_union"):
+        with self._locked_dispatch(
+                "time_union",
+                nbytes_in=sum(s.size for s in stacks) * 4,
+                nbytes_out=stacks[0].size * 4):
             return _launch_barrier(self._plane_fn(sig, len(stacks))(*stacks))
 
     def row_chunk_size(self, shards):
@@ -806,33 +878,68 @@ class StackedEvaluator:
             1, CHUNK_BYTES // (self._padded_len(shards) * WORDS_PER_ROW * 4))
 
     @contextlib.contextmanager
-    def _locked_dispatch(self, kind):
+    def _locked_dispatch(self, kind, nbytes_in=0, nbytes_out=0):
         """Hold the process-wide dispatch lock around one device launch.
 
-        With no QueryProfile active this is exactly the bare lock (the
-        probe is one empty-dict check — the zero-overhead default the
-        observability acceptance gate holds us to). With one active, it
-        measures how long THIS query waited on the lock vs how long its
-        kernel held it, emits a `stacked.kernel` child span (op=kind),
-        and accumulates the profile's lock-wait/kernel-wall totals —
-        the two numbers that split "slow query" into contention vs
-        compute."""
+        Always on (cheap — a few dict/deque ops vs ms-scale kernels;
+        the flightrec bench leg holds the total under 2% of the api_nop
+        path): per-kernel wall/bytes attribution (`kernel_seconds{kernel}`
+        histograms, /debug/kernels), dispatch start/end flight-recorder
+        events, and a watchdog op covering the lock hold — a dispatch
+        that never returns (the r05 tunnel wedge) trips the stall dump
+        instead of hanging silently. With a QueryProfile active it
+        additionally measures how long THIS query waited on the lock vs
+        how long its kernel held it, emits a `stacked.kernel` child span
+        (op=kind), and accumulates the profile's lock-wait/kernel-wall
+        totals — the two numbers that split "slow query" into contention
+        vs compute."""
         prof = _profile.current()
-        if prof is None:
-            with self._dispatch_lock:
-                yield
-            return
+        _flightrec.record("dispatch.start", kernel=kind)
+        token = _flightrec.watch_begin("dispatch." + kind)
         t0 = time.perf_counter()
-        with self._dispatch_lock:
-            t1 = time.perf_counter()
-            with _tracing.start_span("stacked.kernel", op=kind) as span:
-                if span is not None:
-                    span.set_tag("lock_wait_seconds", round(t1 - t0, 6))
-                yield
-            t2 = time.perf_counter()
-        prof.add("dispatch_lock_wait_seconds", t1 - t0)
-        prof.add("kernel_wall_seconds", t2 - t1)
-        prof.add("locked_dispatches", 1)
+        try:
+            with self._dispatch_lock:
+                t1 = time.perf_counter()
+                if prof is None:
+                    yield
+                else:
+                    with _tracing.start_span("stacked.kernel",
+                                             op=kind) as span:
+                        if span is not None:
+                            span.set_tag("lock_wait_seconds",
+                                         round(t1 - t0, 6))
+                        yield
+                t2 = time.perf_counter()
+        finally:
+            _flightrec.watch_end(token)
+        wait, wall = t1 - t0, t2 - t1
+        self._note_kernel(kind, wall, nbytes_in, nbytes_out)
+        _flightrec.record("dispatch.end", kernel=kind,
+                          lock_wait_seconds=round(wait, 6),
+                          kernel_wall_seconds=round(wall, 6))
+        if prof is not None:
+            prof.add("dispatch_lock_wait_seconds", wait)
+            prof.add("kernel_wall_seconds", wall)
+            prof.add("locked_dispatches", 1)
+
+    def _note_kernel(self, kind, wall, nbytes_in, nbytes_out):
+        """Per-kernel-family attribution (see /debug/kernels)."""
+        with self._lock:
+            k = self._kernels.get(kind)
+            if k is None:
+                k = self._kernels[kind] = {
+                    "count": 0, "seconds": 0.0,
+                    "bytes_in": 0, "bytes_out": 0}
+            k["count"] += 1
+            k["seconds"] += wall
+            k["bytes_in"] += nbytes_in
+            k["bytes_out"] += nbytes_out
+        tags = {"kernel": kind}
+        global_stats.timing("kernel_seconds", wall, tags)
+        if nbytes_in:
+            global_stats.count("kernel_bytes_in", nbytes_in, tags)
+        if nbytes_out:
+            global_stats.count("kernel_bytes_out", nbytes_out, tags)
 
     # -- compiled kernels ----------------------------------------------------
 
@@ -842,12 +949,32 @@ class StackedEvaluator:
             if fn is not None:
                 self._fns.move_to_end(key)
                 return fn
-        fn = build()
+        fn = self._wrap_spec_capture(key, build())
         with self._lock:
             self._fns[key] = fn
             while len(self._fns) > MAX_FNS:
                 self._fns.popitem(last=False)
         return fn
+
+    def _wrap_spec_capture(self, key, fn):
+        """Record the arg shape specs on a compiled fn's FIRST call (one
+        dict-membership check afterwards), so /debug/kernels can lower +
+        compile for jax cost_analysis() lazily — the flops/bytes numbers
+        come from XLA, but never at serving-path cost."""
+        def wrapped(*args):
+            if key not in self._fn_specs:
+                try:
+                    import jax
+
+                    self._fn_specs[key] = tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in args)
+                except Exception:  # noqa: BLE001 — attribution only
+                    self._fn_specs[key] = None
+            return fn(*args)
+
+        wrapped._jit_fn = fn
+        return wrapped
 
     @staticmethod
     def _tree_eval(sig, stacks):
@@ -954,7 +1081,9 @@ class StackedEvaluator:
                     args.extend(payloads[pos][1])
                 for _ in range(size - len(chunk)):
                     args.extend(payloads[chunk[0]][1])  # pad: repeat q0
-                with self._locked_dispatch("count"):
+                with self._locked_dispatch(
+                        "count",
+                        nbytes_in=sum(a.size for a in args) * 4):
                     his, los = fn(*args)
                     _launch_barrier((his, los))
                 outs.append((chunk, his, los))
@@ -1133,7 +1262,10 @@ class StackedEvaluator:
             return False, None
         sig, stacks = gathered
         self.dispatches += 1
-        with self._locked_dispatch("filter"):
+        with self._locked_dispatch(
+                "filter",
+                nbytes_in=sum(s.size for s in stacks) * 4,
+                nbytes_out=stacks[0].size * 4):
             return True, _launch_barrier(
                 self._plane_fn(sig, len(stacks))(*stacks))
 
@@ -1162,7 +1294,9 @@ class StackedEvaluator:
             if stack is None:
                 return None
             self.dispatches += 1
-            with self._locked_dispatch("row_counts"):
+            n_in = stack.size * 4 + (filt.size * 4 if filt is not None
+                                     else 0)
+            with self._locked_dispatch("row_counts", nbytes_in=n_in):
                 hi_lo = fn(stack, filt) if filt is not None else fn(stack)
                 _launch_barrier(hi_lo)
                 if not cache:
@@ -1218,7 +1352,11 @@ class StackedEvaluator:
                     return None
                 self.dispatches += 1
                 self.pairwise_dispatches += 1
-                with self._locked_dispatch("pairwise"):
+                n_in = (a_stack.size + b_stack.size
+                        + (filt.size if filt is not None else 0)) * 4
+                with self._locked_dispatch(
+                        "pairwise", nbytes_in=n_in,
+                        nbytes_out=len(a_chunk) * len(b_chunk) * 8):
                     hi, lo = bitplane.pairwise_counts_hi_lo(
                         a_stack, b_stack, filt)
                     _launch_barrier((hi, lo))
@@ -1251,7 +1389,9 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._sum_fn(filt is not None)
         self.dispatches += 1
-        with self._locked_dispatch("sum"):
+        n_in = (planes.size + sign.size + exists.size
+                + (filt.size if filt is not None else 0)) * 4
+        with self._locked_dispatch("sum", nbytes_in=n_in):
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:
@@ -1282,7 +1422,9 @@ class StackedEvaluator:
         planes, sign, exists = data
         fn = self._minmax_fn(filt is not None, is_max)
         self.dispatches += 1
-        with self._locked_dispatch("minmax"):
+        n_in = (planes.size + sign.size + exists.size
+                + (filt.size if filt is not None else 0)) * 4
+        with self._locked_dispatch("minmax", nbytes_in=n_in):
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:
@@ -1321,14 +1463,165 @@ class StackedEvaluator:
                 "stack_entries": len(self._stacks),
                 "rows_stack_bytes": self._rows_stack_bytes,
                 "rows_stack_entries": len(self._rows_stacks),
+                "evictions_by_cause": {
+                    f"{p}.{c}": n
+                    for (p, c), n in sorted(self.pool_evictions.items())},
             }
 
     def invalidate(self):
         with self._lock:
+            n_stack = len(self._stacks)
+            n_rows = len(self._rows_stacks)
             self._stacks.clear()
             self._stack_bytes = 0
             self._rows_stacks.clear()
             self._rows_stack_bytes = 0
+            # zero (don't drop) the gauges: a scraper must see the flush
+            for (index, field, pool_name) in list(self._hbm_ledger):
+                global_stats.gauge("hbm_stack_bytes", 0, {
+                    "index": index, "field": field, "pool": pool_name})
+            self._hbm_ledger.clear()
+            if n_stack:
+                self._count_eviction("stack", "invalidate", n_stack)
+            if n_rows:
+                self._count_eviction("rows", "invalidate", n_rows)
+        if n_stack or n_rows:
+            _flightrec.record("cache.invalidate", stack_entries=n_stack,
+                              rows_entries=n_rows)
+
+    # -- HBM / kernel attribution (GET /debug/hbm, /debug/kernels) -----------
+
+    def hbm_snapshot(self, top=50):
+        """What is resident in HBM and for whom: per-(index, field, pool)
+        byte attribution, the resident entries ranked by bytes with
+        last-hit age, eviction causes, and headroom vs the device's own
+        memory_stats(). `total_bytes` is EXACTLY
+        _stack_bytes + _rows_stack_bytes (the ledger moves in lockstep
+        under the same lock — the acceptance stress test asserts it)."""
+        now = time.time()
+        entries = []
+        with self._lock:
+            for pool_name, pool in (("stack", self._stacks),
+                                    ("rows", self._rows_stacks)):
+                for key, entry in pool.items():
+                    entries.append({
+                        "pool": pool_name,
+                        "kind": key[0],
+                        "index": key[1],
+                        "field": key[2],
+                        "bytes": entry[2],
+                        "last_hit_age_seconds": round(now - entry[4], 3),
+                        "key": repr(key),
+                    })
+            by_index_field = [
+                {"index": i, "field": f, "pool": p, "bytes": b}
+                for (i, f, p), b in sorted(
+                    self._hbm_ledger.items(), key=lambda kv: -kv[1])]
+            snap = {
+                "total_bytes": self._stack_bytes + self._rows_stack_bytes,
+                "stack_bytes": self._stack_bytes,
+                "stack_entries": len(self._stacks),
+                "stack_budget_bytes": MAX_STACK_BYTES,
+                "rows_stack_bytes": self._rows_stack_bytes,
+                "rows_stack_entries": len(self._rows_stacks),
+                "rows_stack_budget_bytes": MAX_ROWS_STACK_BYTES,
+                "by_index_field": by_index_field,
+                "evictions": {
+                    f"{p}.{c}": n
+                    for (p, c), n in sorted(self.pool_evictions.items())},
+            }
+        entries.sort(key=lambda e: -e["bytes"])
+        snap["entries"] = entries[:top]
+        snap["device_memory"] = self._device_memory()
+        return snap
+
+    def _device_memory(self):
+        """Per-device memory_stats() headroom, with the RuntimeMonitor
+        guard: NEVER initializes a backend (jax absent or uninitialized
+        -> None), and backends without memory_stats report nothing."""
+        import sys
+
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is None:
+            return None
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge.backends_are_initialized():
+                return None
+            out = []
+            for d in jax_mod.local_devices():
+                ms = getattr(d, "memory_stats", None)
+                stats = ms() if callable(ms) else None
+                if not stats:
+                    continue
+                in_use = stats.get("bytes_in_use")
+                limit = stats.get("bytes_limit")
+                dev = {"device": str(d.id), "platform": d.platform}
+                if in_use is not None:
+                    dev["bytes_in_use"] = int(in_use)
+                if limit is not None:
+                    dev["bytes_limit"] = int(limit)
+                    if in_use is not None:
+                        dev["headroom_bytes"] = int(limit) - int(in_use)
+                out.append(dev)
+            return out or None
+        except Exception:  # noqa: BLE001 — observability must not raise
+            return None
+
+    def kernels_snapshot(self, include_costs=True):
+        """Per-kernel-family attribution (counts, wall seconds, bytes
+        in/out from _locked_dispatch) plus XLA cost_analysis (flops /
+        bytes accessed) per compiled program — computed ONCE per fn on
+        the first /debug/kernels request, never on the serving path."""
+        with self._lock:
+            kernels = {k: dict(v) for k, v in self._kernels.items()}
+        snap = {"kernels": kernels}
+        if include_costs:
+            snap["compiled"] = self._kernel_cost_list()
+        return snap
+
+    def _kernel_cost_list(self):
+        with self._lock:
+            specs = dict(self._fn_specs)
+            fns = dict(self._fns)
+        out = []
+        for key, spec in specs.items():
+            cost = self._kernel_costs.get(key)
+            if cost is None:
+                cost = self._cost_analysis(fns.get(key), spec)
+                with self._lock:
+                    self._kernel_costs[key] = cost
+            out.append({"family": str(key[0]), "key": repr(key),
+                        "cost": cost})
+        out.sort(key=lambda e: e["key"])
+        return out
+
+    @staticmethod
+    def _cost_analysis(fn, specs):
+        """XLA's own flops/bytes estimate for one compiled program, or {}
+        when the backend/version doesn't expose it. Best effort by
+        design: attribution must never take the serving path down."""
+        if fn is None or not specs:
+            return {}
+        target = getattr(fn, "_jit_fn", fn)
+        try:
+            cost = target.lower(*specs).compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            return {}
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if not isinstance(cost, dict):
+            return {}
+        keep = {k: cost[k]
+                for k in ("flops", "bytes accessed", "optimal_seconds",
+                          "transcendentals")
+                if isinstance(cost.get(k), (int, float))}
+        if keep:
+            return keep
+        numeric = [(k, v) for k, v in sorted(cost.items())
+                   if isinstance(v, (int, float))]
+        return dict(numeric[:8])
 
 
 # Backwards-compatible name (the evaluator originally covered Count only).

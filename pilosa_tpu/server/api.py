@@ -224,6 +224,7 @@ class API:
                 return
             self._resize_draining = True
 
+        from ..utils import flightrec
         from ..utils.stats import global_stats
 
         def replay_one(kind, kwargs):
@@ -247,6 +248,8 @@ class API:
                              ("index_name", "field_name")}
                     if attempt + 1 < self.RESIZE_REPLAY_RETRIES:
                         global_stats.count("resize_replay_retries")
+                        flightrec.record("cluster.replay_retry", kind=kind,
+                                         attempt=attempt + 1, **where)
                         self.logger.printf(
                             "resize write replay failed (attempt %d/%d, "
                             "retrying): %s %r", attempt + 1,
@@ -254,6 +257,8 @@ class API:
                         time.sleep(0.2 * (2 ** attempt))
                     else:
                         global_stats.count("resize_replay_dropped")
+                        flightrec.record("cluster.replay_dropped",
+                                         kind=kind, **where)
                         self.logger.printf(
                             "resize write replay DROPPED after %d "
                             "attempts: %s %r", self.RESIZE_REPLAY_RETRIES,
@@ -278,6 +283,7 @@ class API:
         """(reference: api.Query api.go:135)"""
         import contextlib
 
+        from ..utils import flightrec
         from ..utils import profile as profile_mod
         from ..utils import tracing
 
@@ -297,6 +303,10 @@ class API:
                 index_name, pql if isinstance(pql, str) else str(pql),
                 slow_threshold=self.long_query_time)
         t0 = time.monotonic()
+        # Watchdog coverage for the WHOLE query: a query wedged below the
+        # dispatch lock (or anywhere else) past the deadline trips the
+        # stall dump even if no individual dispatch is registered.
+        wtoken = flightrec.watch_begin("query", index=index_name)
         try:
             with contextlib.ExitStack() as stack:
                 if prof is not None:
@@ -312,6 +322,7 @@ class API:
         except Exception as e:
             raise ApiError(str(e)) from e
         finally:
+            flightrec.watch_end(wtoken)
             if prof is not None:
                 prof.finish()
         self._log_slow_query(index_name, pql, time.monotonic() - t0, prof)
@@ -372,7 +383,11 @@ class API:
                 and elapsed > self.long_query_time):
             import json as _json
 
+            from ..utils import flightrec
+
             q = pql if isinstance(pql, str) else str(pql)
+            flightrec.record("query.slow", index=index_name,
+                             seconds=round(elapsed, 3), pql=q[:200])
             if prof is not None:
                 self.logger.printf(
                     "%.03fs SLOW QUERY index=%s %s profile=%s", elapsed,
@@ -898,7 +913,7 @@ class API:
     def info(self):
         return {"shardWidth": SHARD_WIDTH, "version": __version__}
 
-    def status(self):
+    def status(self, include_remote_observability=False):
         state = "NORMAL"
         replica_n = 1
         nodes = []
@@ -910,8 +925,72 @@ class API:
             nodes = [{"id": "local", "uri": {"scheme": "http"},
                       "isCoordinator": True, "state": "READY"}]
         # replicaN lets a --join'ing node inherit the replication factor
-        return {"state": state, "nodes": nodes, "replicaN": replica_n,
-                "localShardWidth": SHARD_WIDTH}
+        out = {"state": state, "nodes": nodes, "replicaN": replica_n,
+               "localShardWidth": SHARD_WIDTH}
+        # Per-node HBM/kernel summaries. The local node's summary is
+        # computed in-process (always cheap); peer summaries ride the
+        # debug endpoints via server/client.py, coordinator-only and
+        # opt-in (?observability=true) so readiness polls never block on
+        # a partitioned peer.
+        obs = {}
+        local_summary = self._node_observability()
+        if local_summary is not None:
+            local_id = self.cluster.local_id if self.cluster is not None \
+                else "local"
+            obs[local_id] = local_summary
+        if include_remote_observability and self.cluster is not None:
+            coord = self.cluster.coordinator
+            if coord is not None and coord.id == self.cluster.local_id:
+                for node in self.cluster.nodes:
+                    if node.id == self.cluster.local_id:
+                        continue
+                    obs[node.id] = self._peer_observability(node)
+        if obs:
+            out["observability"] = obs
+        return out
+
+    def _node_observability(self):
+        """Compact local HBM + kernel summary for /status (totals only —
+        the full rankings live at /debug/hbm and /debug/kernels)."""
+        local = getattr(self.executor, "local", self.executor)
+        if not hasattr(local, "hbm_stats"):
+            return None
+        hbm = local.hbm_stats(top=0)
+        kernels = local.kernel_stats(include_costs=False)["kernels"]
+        return {
+            "hbm": {k: hbm[k] for k in (
+                "total_bytes", "stack_bytes", "stack_entries",
+                "rows_stack_bytes", "rows_stack_entries")},
+            "kernels": {
+                kind: {"count": v["count"],
+                       "seconds": round(v["seconds"], 6)}
+                for kind, v in sorted(kernels.items())},
+        }
+
+    #: peer observability fetches must never wedge a /status response
+    #: behind a dead node (client default is 30s)
+    OBSERVABILITY_PEER_TIMEOUT = 2
+
+    def _peer_observability(self, node):
+        """One peer's compact summary via its debug endpoints; failures
+        degrade to an error entry instead of failing /status."""
+        try:
+            client = self.client_factory(node.uri)
+            if hasattr(client, "timeout"):
+                client.timeout = self.OBSERVABILITY_PEER_TIMEOUT
+            hbm = client.debug_hbm(top=0)
+            kernels = client.debug_kernels(costs=False).get("kernels", {})
+            return {
+                "hbm": {k: hbm.get(k) for k in (
+                    "total_bytes", "stack_bytes", "stack_entries",
+                    "rows_stack_bytes", "rows_stack_entries")},
+                "kernels": {
+                    kind: {"count": v.get("count"),
+                           "seconds": round(v.get("seconds", 0.0), 6)}
+                    for kind, v in sorted(kernels.items())},
+            }
+        except Exception as e:  # noqa: BLE001 — degraded, not fatal
+            return {"error": str(e)}
 
     def shards_max(self):
         out = {}

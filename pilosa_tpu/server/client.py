@@ -181,6 +181,26 @@ class Client:
     def info(self):
         return self._request("GET", "/info")
 
+    # -- debug / observability -----------------------------------------------
+
+    def debug_hbm(self, top=50):
+        """Per-node HBM ledger (coordinator /status aggregation reads
+        this from every peer)."""
+        return self._request("GET", f"/debug/hbm?top={top}")
+
+    def debug_kernels(self, costs=True):
+        """Per-node kernel attribution; costs=False skips the lazy
+        cost_analysis compile on the peer."""
+        path = "/debug/kernels" + ("" if costs else "?costs=false")
+        return self._request("GET", path)
+
+    def debug_flightrecorder(self, limit=None):
+        """The peer's flight-recorder tail."""
+        path = "/debug/flightrecorder"
+        if limit is not None:
+            path += f"?limit={int(limit)}"
+        return self._request("GET", path)
+
     def export_csv(self, index, field, shard):
         data = self._request(
             "GET", f"/export?index={index}&field={field}&shard={shard}")

@@ -145,6 +145,12 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/vars", self._get_debug_vars),
             Route("GET", r"/debug/queries", self._get_debug_queries),
             Route("GET", r"/debug/traces", self._get_debug_traces),
+            Route("GET", r"/debug/flightrecorder",
+                  self._get_flightrecorder, args=("limit",)),
+            Route("GET", r"/debug/hbm", self._get_debug_hbm,
+                  args=("top",)),
+            Route("GET", r"/debug/kernels", self._get_debug_kernels,
+                  args=("costs",)),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
             Route("POST", r"/debug/pprof/profile/start",
                   self._profile_start),
@@ -357,7 +363,11 @@ class PilosaHTTPServer:
         return RawResponse(csv_text.encode(), "text/csv")
 
     def _get_status(self, req):
-        return self.api.status()
+        # ?observability=true: the coordinator additionally aggregates
+        # every peer's HBM/kernel summary (short-timeout client fetches)
+        return self.api.status(
+            include_remote_observability=(
+                self._q1(req, "observability", "false") == "true"))
 
     def _get_info(self, req):
         return self.api.info()
@@ -558,6 +568,37 @@ class PilosaHTTPServer:
         return {"enabled": False, "spans": [],
                 "hint": "run the server with --tracing memory to retain "
                         "spans"}
+
+    def _get_flightrecorder(self, req):
+        """The black-box event ring: the last N things this process did
+        (dispatches, cache churn, membership flaps, stalls...). ?limit=
+        bounds the tail."""
+        from ..utils import flightrec
+
+        limit = self._q1(req, "limit")
+        return flightrec.snapshot(limit=int(limit) if limit else None)
+
+    def _local_executor(self):
+        ex = getattr(self.api, "executor", None)
+        return getattr(ex, "local", ex)  # ClusterExecutor wraps Executor
+
+    def _get_debug_hbm(self, req):
+        """HBM ledger: resident stack-cache bytes per (index, field,
+        pool), entries ranked by bytes + last-hit age, eviction causes,
+        and device memory_stats headroom."""
+        local = self._local_executor()
+        if not hasattr(local, "hbm_stats"):
+            raise NotFoundError("no stacked evaluator on this node")
+        return local.hbm_stats(top=int(self._q1(req, "top", "50")))
+
+    def _get_debug_kernels(self, req):
+        """Per-kernel-family attribution + XLA cost_analysis per compiled
+        program (?costs=false skips the lazy compile on first request)."""
+        local = self._local_executor()
+        if not hasattr(local, "kernel_stats"):
+            raise NotFoundError("no stacked evaluator on this node")
+        return local.kernel_stats(
+            include_costs=self._q1(req, "costs", "true") != "false")
 
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
     #    profile.cpu config server/config.go) --------------------------------
@@ -815,6 +856,12 @@ class PilosaHTTPServer:
                 "http_request_seconds", _time.perf_counter() - t0, tags)
             if status >= 400:
                 self.stats.count("http_errors", 1, tags)
+            if status >= 500:
+                from ..utils import flightrec
+
+                flightrec.record(
+                    "http.5xx", route=tags["route"],
+                    method=handler.command, status=status)
 
 
 class _SamplingProfiler:

@@ -63,6 +63,7 @@ class StatsClient:
         self._lock = threading.Lock()
         self._counters = defaultdict(float)
         self._gauges = {}
+        self._gauge_fns = {}
         # per series: [count, total seconds, per-bucket counts (+Inf last)]
         self._timings = defaultdict(
             lambda: [0, 0.0, [0] * (len(TIMING_BUCKETS) + 1)])
@@ -75,6 +76,14 @@ class StatsClient:
         with self._lock:
             self._gauges[_key(name, tags)] = value
 
+    def gauge_fn(self, name, fn, tags=None):
+        """Scrape-time gauge: `fn()` is evaluated on every snapshot. For
+        liveness ages (e.g. seconds since a sampler last ran) — a stored
+        gauge freezes when its writer wedges, which is exactly the moment
+        the metric matters."""
+        with self._lock:
+            self._gauge_fns[_key(name, tags)] = fn
+
     def timing(self, name, seconds, tags=None):
         with self._lock:
             t = self._timings[_key(name, tags)]
@@ -86,8 +95,16 @@ class StatsClient:
         """(counters, gauges, timings) — timings as (count, sum) pairs;
         `histograms()` adds the bucket counts."""
         with self._lock:
-            return (dict(self._counters), dict(self._gauges),
-                    {k: (v[0], v[1]) for k, v in self._timings.items()})
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timings = {k: (v[0], v[1]) for k, v in self._timings.items()}
+            fns = list(self._gauge_fns.items())
+        for k, fn in fns:  # outside the lock: fns may call gauge()
+            try:
+                gauges[k] = fn()
+            except Exception:
+                pass
+        return (counters, gauges, timings)
 
     def histograms(self):
         """{key: (count, sum, bucket_counts)} — bucket_counts are
@@ -249,6 +266,7 @@ class RuntimeMonitor:
         self._stop = threading.Event()
         self._thread = None
         self._t0 = time.time()
+        self.last_sample_time = None
 
     def sample(self):
         self.stats.gauge("uptime_seconds", time.time() - self._t0)
@@ -264,6 +282,7 @@ class RuntimeMonitor:
         except OSError:
             pass  # non-procfs platform
         self._sample_devices()
+        self.last_sample_time = time.time()
 
     def _sample_devices(self):
         """Per-device JAX memory gauges so HBM pressure sits next to RSS.
@@ -306,7 +325,15 @@ class RuntimeMonitor:
         while not self._stop.wait(self.interval):
             self.sample()
 
+    def _sample_age(self):
+        return (time.time() - self.last_sample_time
+                if self.last_sample_time is not None else -1)
+
     def start(self):
+        # Evaluated at scrape time, so a wedged sampler thread shows up
+        # as an ever-growing age instead of a frozen small value.
+        registry_of(self.stats).gauge_fn(
+            "runtime_monitor_last_sample_age_seconds", self._sample_age)
         self.sample()
         self._thread = threading.Thread(
             target=self._run, name="pilosa-runtime-monitor", daemon=True)

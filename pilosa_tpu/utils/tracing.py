@@ -176,12 +176,26 @@ def inject_headers(headers=None):
     return headers
 
 
+def _header_get(headers, name):
+    """Case-insensitive header lookup. http.server's Message headers are
+    already case-insensitive, but plain dicts (tests, proxies that
+    lowercase header names per HTTP/2) are not — fall back to a scan."""
+    value = headers.get(name)
+    if value is not None:
+        return value
+    want = name.lower()
+    for k in headers:
+        if isinstance(k, str) and k.lower() == want:
+            return headers[k]
+    return None
+
+
 @contextlib.contextmanager
 def span_from_headers(name, headers, **tags):
     """Continue a remote trace from incoming HTTP headers (case-insensitive
-    mapping, e.g. http.server message headers)."""
-    trace_id = headers.get(TRACE_HEADER)
-    parent_id = headers.get(PARENT_HEADER)
+    lookup — see _header_get)."""
+    trace_id = _header_get(headers, TRACE_HEADER)
+    parent_id = _header_get(headers, PARENT_HEADER)
     if trace_id is None:
         with start_span(name, **tags) as span:
             yield span

@@ -47,7 +47,7 @@ import os
 import queue
 import threading
 
-from . import tracing
+from . import flightrec, tracing
 from .stats import global_stats
 
 
@@ -265,8 +265,16 @@ class WorkPool:
         with self._stats_lock:
             self.jobs_total += 1
             self.tasks_total += len(items)
+            # Saturation: every worker is already busy when more work
+            # arrives — the new job queues behind in-flight shards.
+            saturated = self._busy >= self.workers and self._queued_tasks > 0
+            backlog = self._queued_tasks
             self._queued_tasks += len(items)
             self._push_gauges()
+        if saturated:
+            flightrec.record("workpool.saturated", pool=self.name,
+                             workers=self.workers, backlog=backlog,
+                             incoming=len(items))
         for _ in range(min(self.workers, len(items))):
             self._queue.put(job)
         while not job.done.wait(timeout=1.0):

@@ -113,6 +113,33 @@ def test_cpu_fallback_record_when_every_probe_dies():
     assert tunnel["probe_deadline_s"] == 5.0
 
 
+def test_failure_forensics_attached_to_error_record():
+    # A hung attempt must not die anonymously: the final error record
+    # carries a per-attempt log (which phase each attempt died in, why)
+    # and the last child's flight-recorder tail, fetched over the debug
+    # port BEFORE the kill (the ring dies with the process).
+    code, rec, _ = run_bench("hang", attempts="2")
+    assert code == 1
+    attempts = rec["attempts"]
+    assert len(attempts) == 2
+    for i, a in enumerate(attempts):
+        assert a["attempt"] == i + 1
+        assert a["phase"] == "probe"  # "hang" wedges before the marker
+        assert "probe" in a["reason"] or "deadline" in a["reason"]
+    assert rec["phase"] == "probe"
+    # the tail proves the child was alive and announced itself
+    tail = rec["flightrec"]
+    assert any(e["kind"] == "bench.child_start" for e in tail["events"])
+
+
+def test_child_error_record_carries_phase():
+    # An error AFTER the probe marker is attributed to the main phase.
+    code, rec, _ = run_bench("error")
+    assert code == 1
+    assert rec["phase"] == "main"
+    assert rec["attempts"][-1]["reason"] == "fake failure"
+
+
 @pytest.mark.skipif(
     not os.environ.get("PILOSA_TPU_BENCH_E2E"),
     reason="several-minute full bench; set PILOSA_TPU_BENCH_E2E=1 to run")

@@ -132,6 +132,7 @@ def test_stacks_sharded_over_devices(setup):
     holder, api = setup
     ex = Executor(holder)
     assert ex.execute("st", "Count(Row(f=1))")[0] > 0
-    (_, stack, _, _), = list(ex._stacked._stacks.values())
+    entry, = list(ex._stacked._stacks.values())
+    stack = entry[1]
     assert len(stack.sharding.device_set) == len(jax.devices())
     assert stack.shape[0] % len(jax.devices()) == 0  # zero-padded

@@ -53,6 +53,63 @@ def test_span_from_headers_without_context(tracer):
         assert span.parent_id is None
 
 
+def test_extract_headers_case_insensitive(tracer):
+    """HTTP/2 proxies and some test clients lowercase header names;
+    extraction must not depend on the canonical casing."""
+    with tracing.start_span("origin") as origin:
+        headers = tracing.inject_headers()
+    lowered = {k.lower(): v for k, v in headers.items()}
+    assert lowered != headers  # the canonical names ARE mixed-case
+    with tracing.span_from_headers("remote", lowered) as remote:
+        assert remote.trace_id == origin.trace_id
+        assert remote.parent_id == origin.span_id
+    # mixed garbage casing also resolves
+    weird = {"x-pILOSA-tRACE-iD": "t123", "X-PILOSA-SPAN-ID": "s456"}
+    with tracing.span_from_headers("remote2", weird) as remote:
+        assert remote.trace_id == "t123"
+        assert remote.parent_id == "s456"
+
+
+def test_trace_headers_reinjected_on_each_request(tracer):
+    """Every Client._request call injects the CURRENT span's headers —
+    so a replica retry (a second request inside the same span) carries
+    the trace context again, not just the first attempt."""
+    import http.server
+    import threading
+
+    from pilosa_tpu.server.client import Client
+
+    seen = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen.append(dict(self.headers.items()))
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = Client(f"http://127.0.0.1:{srv.server_address[1]}")
+        with tracing.start_span("fanout") as span:
+            client.status()  # first attempt
+            client.status()  # the "retry": same span, new request
+        assert len(seen) == 2
+        for headers in seen:
+            got = {k.lower(): v for k, v in headers.items()}
+            assert got[tracing.TRACE_HEADER.lower()] == span.trace_id
+            assert got[tracing.PARENT_HEADER.lower()] == span.span_id
+    finally:
+        srv.shutdown()
+
+
 def test_executor_spans(tracer, tmp_path):
     from tests.harness import ServerHarness
 
