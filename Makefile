@@ -14,9 +14,9 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_bench_orchestrator.py
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
-	test-flightrec lint bench-cpu
+	test-flightrec test-explain lint bench-cpu
 
-test: test-core test-distributed test-flightrec
+test: test-core test-distributed test-flightrec test-explain
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -29,6 +29,11 @@ test-distributed:
 # exactness, kernel attribution, and the /debug endpoints serving them.
 test-flightrec:
 	$(PY) -m pytest tests/test_flightrec.py $(PYTEST_FLAGS)
+
+# EXPLAIN/ANALYZE surface: plan trees, the cost model, misestimate
+# flagging + the /debug/plans ring, and cluster sub-plan aggregation.
+test-explain:
+	$(PY) -m pytest tests/test_explain.py $(PYTEST_FLAGS)
 
 # Query observability surface: per-query profiles, histograms, the
 # slow-query log, trace retention, and the exposition formats.

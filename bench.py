@@ -261,6 +261,11 @@ def main():
             "cpu_baseline_qps": round(cpu_qps, 2),
             "count": got,
             "served": served,
+            # EXPLAIN plan shape of the served query (measured by the
+            # served leg; surfaced here so plan regressions show up in
+            # the headline record too)
+            "plan_nodes": served.get("plan_nodes"),
+            "plan_strategy": served.get("plan_strategy"),
             "served_pct_of_kernel": round(
                 100 * served["served_qps"] / qps, 1)
             if "served_qps" in served else None,

@@ -389,6 +389,23 @@ def measure_served_1b(n_shards=954, workers=256, n_queries=4096,
         st = e.stacked_stats()
         batches = st["count_batches"] - st0["count_batches"]
         batched = st["count_batched_queries"] - st0["count_batched_queries"]
+
+        # explain=plan on the served query: plan-node count + chosen
+        # strategy ride the bench JSON (and double as a zero-dispatch
+        # check at 1B-column scale)
+        from pilosa_tpu.exec import plan as plan_mod
+        from pilosa_tpu.exec.executor import ExecOptions
+
+        d0 = e._stacked.cache_stats()["dispatches"]
+        e.execute("b", queries[0], options=ExecOptions(explain="plan"))
+        if e._stacked.cache_stats()["dispatches"] != d0:
+            raise AssertionError("explain=plan dispatched to the device")
+        env = plan_mod.take_last()
+
+        def _nodes(d):
+            return 1 + sum(_nodes(c) for c in d.get("children", [])
+                           if isinstance(c, dict))
+
         return {
             "served_qps": round(served_qps, 2),
             "n_shards": n_shards,
@@ -398,6 +415,8 @@ def measure_served_1b(n_shards=954, workers=256, n_queries=4096,
             "ingest_s": round(ingest_s, 1),
             "count_batches": batches,
             "queries_per_dispatch": round(batched / max(batches, 1), 1),
+            "plan_nodes": sum(_nodes(c) for c in env["calls"]),
+            "plan_strategy": env["calls"][0].get("strategy"),
         }
     finally:
         holder.close()
@@ -830,6 +849,101 @@ def bench_flightrec_overhead():
         "hbm_entries": len(hbm["entries"])})
 
 
+# ---------------------------------------------------------------- config 9
+
+def bench_explain_overhead():
+    """EXPLAIN/ANALYZE acceptance leg.
+
+    Three claims, one JSON line:
+    1. A query that does NOT ask for explain pays only the per-op
+       strategy hooks (one thread-local read + one early return each) —
+       microbenched like flightrec_overhead's per-dispatch probe and
+       asserted <2% of an api_nop query; enabled/plan/analyze wall
+       clocks are published alongside.
+    2. explain=plan produces the full plan tree with ZERO device
+       dispatches.
+    3. explain=analyze grafts actual wall/dispatch counters onto the
+       same tree; node counts for both ride the bench JSON.
+    """
+    from pilosa_tpu.exec import plan as plan_mod
+    from pilosa_tpu.exec.executor import ExecOptions
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    platform, holder, api, ex = _env()
+    api.create_index("xp")
+    api.create_field("xp", "a")
+    api.create_field("xp", "b")
+    idx = holder.index("xp")
+    n_shards = 4 if platform != "cpu" else 2
+    rng = np.random.default_rng(29)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=100_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    idx.field("b").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+
+    api.executor = ex
+    st = ex._stacked
+    pql = "Count(Intersect(Row(a=1), Row(b=1)))"
+    api.query("xp", pql)  # warm stacks + compile
+
+    n_q = 50 if platform == "cpu" else 200
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("xp", pql)
+    enabled_ms = (time.perf_counter() - t0) / n_q * 1000
+
+    # per-op hook microbenchmark: exactly what the disabled path adds
+    # (_note_strategy with no TLS notes and no active profile)
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        ex._note_strategy("Count", "stacked")
+    per_note_ns = (time.perf_counter() - t0) / n_probe * 1e9
+
+    # explain=plan: full tree, zero dispatches; its node count is an
+    # upper bound on strategy-hook calls per query (hooks fire at most
+    # once per op)
+    d0 = st.cache_stats()["dispatches"]
+    out = ex.execute("xp", pql, options=ExecOptions(explain="plan"))
+    assert out == [], "explain=plan returned results"
+    assert st.cache_stats()["dispatches"] == d0, (
+        "explain=plan dispatched to the device")
+    env = plan_mod.take_last()
+
+    def _nodes(d):
+        return 1 + sum(_nodes(c) for c in d.get("children", [])
+                       if isinstance(c, dict))
+
+    plan_nodes = sum(_nodes(c) for c in env["calls"])
+    overhead_pct = per_note_ns * plan_nodes / 1e6 / enabled_ms * 100
+    assert overhead_pct < 2.0, (
+        f"explain-disabled strategy hooks cost {overhead_pct:.3f}% of an "
+        "api_nop query — no longer an always-on-safe default")
+
+    # explain=analyze: actuals grafted onto the same tree
+    t0 = time.perf_counter()
+    ex.execute("xp", pql, options=ExecOptions(explain="analyze"))
+    analyze_ms = (time.perf_counter() - t0) * 1000
+    aenv = plan_mod.take_last()
+    top = aenv["calls"][0]
+    assert top.get("actual"), "analyze grafted no actuals"
+
+    _close(holder)
+    _emit("explain_overhead_pct", overhead_pct, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "per_note_ns": round(per_note_ns, 1),
+        "plan_nodes": plan_nodes,
+        "analyze_nodes": sum(_nodes(c) for c in aenv["calls"]),
+        "api_nop_enabled_ms": round(enabled_ms, 3),
+        "analyze_ms": round(analyze_ms, 3),
+        "overhead_pct": round(overhead_pct, 4),
+        "strategy": top.get("strategy"),
+        "actual_dispatches": top.get("actual", {}).get("dispatches"),
+        "misestimates": aenv.get("misestimates")})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -839,6 +953,7 @@ CONFIGS = {
     "groupby_pairwise": bench_groupby_pairwise,
     "workpool_scaling": bench_workpool_scaling,
     "flightrec_overhead": bench_flightrec_overhead,
+    "explain_overhead": bench_explain_overhead,
 }
 
 

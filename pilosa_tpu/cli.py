@@ -248,6 +248,17 @@ def cmd_server(args):
             parse_duration(str(wd_deadline)), logger=_FrLogger())
     _flightrec.install_crash_handler(logger=_FrLogger())
 
+    # EXPLAIN ANALYZE plan retention + misestimate threshold
+    # (exec/plan.py module state, like the flight recorder above).
+    prs = config.get("plan-ring-size")
+    emf = config.get("explain-misestimate-factor")
+    if prs is not None or emf is not None:
+        from .exec import plan as _plan
+
+        _plan.configure(
+            ring_size=int(prs) if prs is not None else None,
+            misestimate_factor=float(emf) if emf is not None else None)
+
     # Trace retention (GET /debug/traces): "memory" installs a bounded
     # InMemoryTracer ring; the default keeps the nop tracer, whose hot
     # path allocates no spans at all (query profiles via ?profile=true /
@@ -683,7 +694,8 @@ def _apply_server_flags(config, args):
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
                  "replicas", "spmd_port", "long_query_time",
                  "max_writes_per_request", "tracing", "workers",
-                 "flight_recorder_size", "watchdog_deadline"):
+                 "flight_recorder_size", "watchdog_deadline",
+                 "plan_ring_size", "explain_misestimate_factor"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -842,6 +854,14 @@ def main(argv=None):
                    help="stall watchdog deadline (e.g. 30s, 2m): dump "
                         "stacks + recorder tail when a dispatch or query "
                         "runs past it; disabled when unset")
+    p.add_argument("--plan-ring-size", type=int, default=None,
+                   help="retained misestimated EXPLAIN ANALYZE plans "
+                        "(GET /debug/plans; default 128, 0 disables "
+                        "retention)")
+    p.add_argument("--explain-misestimate-factor", type=float, default=None,
+                   help="flag a plan node when actual cost deviates from "
+                        "the estimate by more than this factor in either "
+                        "direction (default 3.0)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")
@@ -927,6 +947,8 @@ def main(argv=None):
     p.add_argument("--workers", type=int, default=None)
     p.add_argument("--flight-recorder-size", type=int, default=None)
     p.add_argument("--watchdog-deadline", default=None)
+    p.add_argument("--plan-ring-size", type=int, default=None)
+    p.add_argument("--explain-misestimate-factor", type=float, default=None)
     p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)

@@ -216,19 +216,116 @@ class ClusterExecutor:
         # fetch the cluster-wide shard list ONCE per query, not per call
         if shards is None and any(not c.writes() for c in query.calls):
             shards = self.cluster_shards(idx)
+
+        explain = getattr(opt, "explain", None)
+        if explain == "plan":
+            return self._explain_cluster_plan(idx, query, shards, opt)
+
+        plan_calls = [] if explain == "analyze" else None
         results = []
         for call in query.calls:
-            results.append(self._execute_call(idx, call, shards, opt))
+            if plan_calls is None:
+                results.append(self._execute_call(idx, call, shards, opt))
+                continue
+            # ?explain=analyze: every fan-out leg runs its own analyze
+            # and hands back a sub-plan; the coordinator node wraps them
+            sink = []
+            results.append(
+                self._execute_call(idx, call, shards, opt, plan_sink=sink))
+            plan_calls.append(
+                self._cluster_plan_node(idx, call, shards, sink))
+        if plan_calls is not None:
+            self._stash_cluster_plan(idx, "analyze", plan_calls, shards)
         return translate_results(idx, query.calls, results)
+
+    def _cluster_plan_node(self, idx, call, shards, children):
+        """The coordinator's node for one fanned-out call: per-node
+        sub-plans as children (already-serialized dicts)."""
+        from ..exec import plan as plan_mod
+
+        node = plan_mod.PlanNode(
+            call.name, pql=call_to_pql(call),
+            strategy="write" if call.writes() else "cluster-map-reduce")
+        node.annotations["nodes"] = len(children)
+        node.annotations["shards"] = len(shards or [])
+        if self.spmd is not None and not call.writes():
+            # the SPMD collective plane is bypassed under explain so the
+            # per-node sub-plans can be captured; record that the normal
+            # path may differ
+            node.annotations["spmd_bypassed"] = True
+        node.children = list(children)
+        return node
+
+    def _stash_cluster_plan(self, idx, mode, plan_calls, shards):
+        from ..exec import plan as plan_mod
+        from ..utils import profile as profile_mod
+
+        prof = profile_mod.current()
+        env = plan_mod.envelope(
+            idx.name, mode, plan_calls, shards=len(shards or []),
+            trace_id=prof.root.trace_id if prof is not None else None)
+        if mode == "analyze":
+            # the coordinator node itself never flags; the misestimates
+            # live inside the per-node sub-plans — roll them up
+            mis = sum(
+                len(child["plan"].get("misestimates") or [])
+                for node in env["calls"]
+                for child in node.get("children", [])
+                if isinstance(child, dict)
+                and isinstance(child.get("plan"), dict))
+            env["misestimates"] = mis
+            if mis:
+                plan_mod.record(env)
+        plan_mod.stash(env)
+        return env
+
+    def _explain_cluster_plan(self, idx, query, shards, opt):
+        """?explain=true on a cluster: per call, gather one sub-plan per
+        owning node — the local planner for our shards, an
+        explain="plan" fan-out request for peers (host-side planning on
+        each node; nothing executes anywhere)."""
+        from ..exec import plan as plan_mod
+
+        local_planner = plan_mod.Planner(self.local)
+        plan_calls = []
+        for call in query.calls:
+            if call.writes():
+                plan_calls.append(
+                    local_planner.plan_call(idx, call, shards, opt))
+                continue
+            by_node = self.cluster.shards_by_node(idx.name, shards or [])
+            children = []
+            for node, node_shards in by_node.items():
+                entry = {"node": node.id, "shards": len(node_shards)}
+                try:
+                    if node.id == self.cluster.local_id:
+                        entry["plan"] = local_planner.plan_call(
+                            idx, call, node_shards,
+                            self._remote_opt(opt)).to_dict()
+                    else:
+                        resp = self._client(node).query(
+                            idx.name, call_to_pql(call),
+                            shards=node_shards, remote=True,
+                            explain="plan")
+                        sub = resp.get("plan") or {}
+                        calls = sub.get("calls") or [None]
+                        entry["plan"] = calls[0]
+                except Exception as e:  # degraded, not fatal: a plan
+                    entry["error"] = str(e)  # must never fail the query
+                children.append(entry)
+            plan_calls.append(
+                self._cluster_plan_node(idx, call, shards, children))
+        self._stash_cluster_plan(idx, "plan", plan_calls, shards)
+        return []
 
     # -- per-call ------------------------------------------------------------
 
-    def _execute_call(self, idx, call, shards, opt):
+    def _execute_call(self, idx, call, shards, opt, plan_sink=None):
         if call.name in ("Set", "Clear"):
             return self._execute_replicated_write(idx, call)
         if call.name in ("SetRowAttrs", "SetColumnAttrs"):
             return self._execute_attr_write(idx, call)
-        return self._map_reduce(idx, call, shards, opt)
+        return self._map_reduce(idx, call, shards, opt, plan_sink=plan_sink)
 
     def _remote_opt(self, opt):
         return ExecOptions(
@@ -293,14 +390,15 @@ class ClusterExecutor:
 
     # -- mapReduce -----------------------------------------------------------
 
-    def _map_reduce(self, idx, call, shards, opt):
+    def _map_reduce(self, idx, call, shards, opt, plan_sink=None):
         if shards is None:
             shards = self.cluster_shards(idx)
         # SPMD data plane: coverable Count/Sum/Min/Max/TopN/GroupBy trees
         # merge over collectives (cluster/spmd.py), initiated from any
         # node (non-coordinators forward in one hop); anything it declines
-        # falls through to the HTTP merge below.
-        if self.spmd is not None:
+        # falls through to the HTTP merge below. Bypassed under
+        # explain=analyze: per-node sub-plans need per-node execution.
+        if self.spmd is not None and plan_sink is None:
             used, result = self.spmd.maybe_execute(idx, call, shards)
             if used:
                 return result
@@ -322,11 +420,35 @@ class ClusterExecutor:
         use_proto = _internal_wire() != "json"
         pql = call_to_pql(call)  # invariant across nodes and retries
 
+        def note_plan(node, node_shards, sub_plan):
+            with lock:
+                plan_sink.append({"node": node.id,
+                                  "shards": len(node_shards),
+                                  "plan": sub_plan})
+
         def run_node(node, node_shards, tried=()):
             try:
                 if node.id == self.cluster.local_id:
-                    result = self.local.execute_call(
-                        idx, call, node_shards, self._remote_opt(opt))
+                    if plan_sink is not None:
+                        result, pnode = self.local.explain_analyze_call(
+                            idx, call, node_shards, self._remote_opt(opt))
+                        note_plan(node, node_shards, pnode.to_dict())
+                    else:
+                        result = self.local.execute_call(
+                            idx, call, node_shards, self._remote_opt(opt))
+                elif plan_sink is not None:
+                    # analyze legs ride the JSON wire regardless of the
+                    # configured internal encoding: the proto response has
+                    # no plan slot
+                    resp = self._client(node).query(
+                        idx.name, pql, shards=node_shards, remote=True,
+                        exclude_row_attrs=opt.exclude_row_attrs,
+                        exclude_columns=opt.exclude_columns,
+                        explain="analyze")
+                    result = result_from_json(resp["results"][0])
+                    sub = resp.get("plan") or {}
+                    calls = sub.get("calls") or [None]
+                    note_plan(node, node_shards, calls[0])
                 elif use_proto:
                     # protobuf data plane for node-to-node fan-out
                     # (reference: remoteExec posts proto QueryRequests,

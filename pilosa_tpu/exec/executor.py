@@ -51,13 +51,17 @@ class FieldNotFound(ExecError):
 class ExecOptions:
     def __init__(self, shards=None, exclude_columns=False,
                  column_attrs=False, exclude_row_attrs=False, remote=False,
-                 profile=False):
+                 profile=False, explain=None):
         self.shards = shards
         self.exclude_columns = exclude_columns
         self.column_attrs = column_attrs
         self.exclude_row_attrs = exclude_row_attrs
         self.remote = remote
         self.profile = profile
+        # None (execute normally), "plan" (?explain=true: build the plan
+        # tree, execute NOTHING), or "analyze" (?explain=analyze: execute
+        # and graft actual costs onto the plan) — see exec/plan.py
+        self.explain = explain
 
 
 def uint_arg(call, key):
@@ -160,7 +164,8 @@ def unwrap_options(call, opt):
             shards=opt.shards, exclude_columns=opt.exclude_columns,
             column_attrs=opt.column_attrs,
             exclude_row_attrs=opt.exclude_row_attrs,
-            remote=opt.remote, profile=opt.profile)
+            remote=opt.remote, profile=opt.profile,
+            explain=getattr(opt, "explain", None))
         for key, value in call.args.items():
             if key == "excludeColumns":
                 merged.exclude_columns = bool(value)
@@ -189,11 +194,18 @@ class Executor:
     def __init__(self, holder, max_writes_per_request=0):
         from .stacked import StackedEvaluator
 
+        import threading
+
         self.holder = holder
         # reject write batches past this many write calls; <=0 = unlimited
         # (reference: Executor.MaxWritesPerRequest executor.go:55)
         self.max_writes_per_request = max_writes_per_request
         self._stacked = StackedEvaluator()
+        # ?explain=analyze strategy capture: decision points append the
+        # path they actually took to `notes` (set per top-level call by
+        # explain_analyze_call; every strategy choice runs on the calling
+        # thread, so a thread-local cannot observe another query's calls)
+        self._explain_tls = threading.local()
 
     def stacked_stats(self):
         """Stack-cache observability snapshot (see StackedEvaluator)."""
@@ -229,6 +241,20 @@ class Executor:
 
             translate_calls(idx, query.calls)
 
+        explain = getattr(opt, "explain", None)
+        if explain == "plan":
+            # EXPLAIN without ANALYZE: build the annotated plan tree from
+            # host-side metadata only and execute NOTHING — the stacked
+            # dispatch counters must not move (tests pin the delta at 0)
+            from . import plan as plan_mod
+
+            nodes = plan_mod.Planner(self).plan_query(
+                idx, query.calls, shards, opt)
+            plan_mod.stash(plan_mod.envelope(
+                idx.name, "plan", nodes,
+                shards=len(self._call_shards(idx, shards))))
+            return []
+
         from ..utils import profile as profile_mod
         from ..utils import tracing
         from ..utils.stats import global_stats
@@ -244,13 +270,21 @@ class Executor:
         prof = profile_mod.current()
         before = self._stacked.cache_stats() if prof is not None else None
 
+        plan_nodes = [] if explain == "analyze" else None
         results = []
         with tracing.start_span(
                 "executor.Execute", index=index_name) as span:
             for call in query.calls:
                 t_call = _time.perf_counter()
                 with tracing.start_span(f"executor.execute{call.name}"):
-                    results.append(self.execute_call(idx, call, shards, opt))
+                    if plan_nodes is None:
+                        results.append(
+                            self.execute_call(idx, call, shards, opt))
+                    else:
+                        result, node = self.explain_analyze_call(
+                            idx, call, shards, opt)
+                        results.append(result)
+                        plan_nodes.append(node)
                 # per-PQL-op latency histogram (global registry: the
                 # executor predates any per-server stats wiring, and
                 # registry_of() resolves /metrics to this same registry)
@@ -274,9 +308,67 @@ class Executor:
                      (after["planes_uploaded"] - before["planes_uploaded"])
                      * WORDS_PER_ROW * 4)
 
+        if plan_nodes is not None:
+            from . import plan as plan_mod
+
+            env = plan_mod.envelope(
+                idx.name, "analyze", plan_nodes,
+                shards=len(self._call_shards(idx, shards)),
+                trace_id=prof.root.trace_id if prof is not None else None)
+            plan_mod.stash(env)
+            if prof is not None:
+                prof.set_tag("plan_summary", plan_mod.summary(plan_nodes))
+            # only misestimated plans earn a ring slot: the ring is the
+            # triage queue for cost-model drift, not a second query log
+            if any(n.misestimates for n in plan_nodes):
+                plan_mod.record(env)
+
         if not opt.remote:
             results = translate_results(idx, query.calls, results)
         return results
+
+    def explain_analyze_call(self, idx, call, shards, opt):
+        """One ?explain=analyze step: build the call's plan node FIRST
+        (so estimates can't peek at the outcome), execute it while
+        capturing strategy notes + stacked-counter and per-kernel-family
+        deltas, then graft the actuals and flag misestimates. Returns
+        (result, PlanNode)."""
+        import time as _time
+
+        from . import plan as plan_mod
+
+        node = plan_mod.Planner(self).plan_call(idx, call, shards, opt)
+        notes = self._explain_tls.notes = []
+        before = self._stacked.cache_stats()
+        kern_before = self._stacked.kernel_profile()
+        t0 = _time.perf_counter()
+        try:
+            result = self.execute_call(idx, call, shards, opt)
+        finally:
+            self._explain_tls.notes = None
+        wall = _time.perf_counter() - t0
+        plan_mod.graft_actual(
+            node, wall, before, self._stacked.cache_stats(),
+            kern_before, self._stacked.kernel_profile(), strategies=notes)
+        return result, node
+
+    def _note_strategy(self, op, strategy, **detail):
+        """Record the strategy a decision point ACTUALLY took. Feeds the
+        analyze grafting (thread-local notes) and, when a profile is
+        active, the profile's `strategies` tag — which is what SLOW QUERY
+        lines print, so a wedge can be triaged from logs alone."""
+        from ..utils import profile as profile_mod
+
+        notes = getattr(self._explain_tls, "notes", None)
+        prof = profile_mod.current()
+        if notes is None and prof is None:
+            return  # nothing listening: stay off the hot path
+        entry = {"op": op, "strategy": strategy}
+        entry.update(detail)
+        if notes is not None:
+            notes.append(entry)
+        if prof is not None:
+            prof.note("strategies", entry)
 
     def execute_call(self, idx, call, shards, opt):
         handler = {
@@ -608,7 +700,9 @@ class Executor:
         # in one fused dispatch on generation-cached [S, W] stacks.
         fast = self._stacked.try_count(idx, call.children[0], shard_list)
         if fast is not None:
+            self._note_strategy("Count", "stacked")
             return fast
+        self._note_strategy("Count", "per-shard")
 
         def count_shard(shard):
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
@@ -661,8 +755,11 @@ class Executor:
         fast = self._stacked.try_sum(
             idx, field, self._agg_filter_call(idx, call), shard_list)
         if fast is not None:
+            self._note_strategy("Sum", "stacked-sum")
             total, count = fast
             return ValCount(total + opts.base * count, count)
+        self._note_strategy("Sum", "per-shard")
+
         def sum_shard(shard):
             data = self._bsi_planes(field, shard)
             if data is None:
@@ -742,14 +839,17 @@ class Executor:
         # Fast path: the narrowing bit-plane walk runs ONCE over stacked
         # [D, S, W] planes (globally — identical result to the per-shard
         # merge) instead of once per shard.
+        op_name = "Max" if is_max else "Min"
         fast = self._stacked.try_minmax(
             idx, field, self._agg_filter_call(idx, call), shard_list,
             is_max)
         if fast is not None:
+            self._note_strategy(op_name, "stacked-minmax")
             mag, count = fast
             if mag is None:
                 return ValCount()
             return ValCount(mag + field.options.base, count)
+        self._note_strategy(op_name, "per-shard")
         # Ordered reduce: larger/smaller tie-breaking is order-sensitive,
         # so the pool's shard-order reduction is what keeps every worker
         # count bit-identical to the serial loop.
@@ -1002,10 +1102,15 @@ class Executor:
                     idx, field.name, candidates, filt, shard_list,
                     view_name)
                 if totals is not None:
+                    if call is not None:
+                        self._note_strategy(call.name,
+                                            "stacked-row-counts")
                     if restrict_ids is not None:
                         for r in restrict_ids:
                             totals.setdefault(int(r), 0)
                     return totals
+        if call is not None:
+            self._note_strategy(call.name, "per-shard-chunked")
 
         # Fallback: per-shard chains, but over the SAME global candidate
         # set as the fast path (union across fragments), so both paths
@@ -1159,8 +1264,15 @@ class Executor:
         totals = self._group_by_stacked(
             idx, fields, child_rows, filter_call, shard_list)
         if totals is None:
+            self._note_strategy("GroupBy", "per-shard")
             totals = self._group_by_per_shard(
                 idx, fields, child_rows, filter_call, shard_list)
+        elif len(fields) == 1:
+            self._note_strategy("GroupBy", "stacked-row-counts")
+        else:
+            tile = self._stacked.row_chunk_size(tuple(shard_list))
+            self._note_strategy("GroupBy", "stacked-pairwise",
+                                tile=[tile, tile])
         if previous is not None:
             prev_t = tuple(previous)
             totals = {g: c for g, c in totals.items() if g > prev_t}
@@ -1312,7 +1424,8 @@ class Executor:
             shards=opt.shards, exclude_columns=opt.exclude_columns,
             column_attrs=opt.column_attrs,
             exclude_row_attrs=opt.exclude_row_attrs,
-            remote=opt.remote, profile=opt.profile)
+            remote=opt.remote, profile=opt.profile,
+            explain=getattr(opt, "explain", None))
         for key, value in call.args.items():
             if key == "shards":
                 if not isinstance(value, list):

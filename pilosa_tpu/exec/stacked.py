@@ -1623,6 +1623,155 @@ class StackedEvaluator:
                    if isinstance(v, (int, float))]
         return dict(numeric[:8])
 
+    # -- plan-mode introspection (exec/plan.py) ------------------------------
+    #
+    # EXPLAIN mirrors the strategy gates WITHOUT executing: everything
+    # below is host-only (schema lookups, fragment generation walks, pool
+    # membership under the lock) and side-effect free — no LRU bumps, no
+    # hit/miss counters, no stack builds, no dispatches. The acceptance
+    # contract for ?explain=true is a dispatch-counter delta of zero.
+
+    def _probe(self, key, idx, field_name, view_name):
+        """Presence + freshness of one pool entry with NO side effects.
+        Mirrors _cache_get_fast/_cache_get validation (view stamp first,
+        per-shard generation walk second) but never touches LRU order,
+        last-hit stamps, or the hit/miss counters — a plan must not
+        distort the telemetry it is trying to explain."""
+        field = idx.field(field_name)
+        view = field.view(view_name) if field is not None else None
+        if view is None:
+            return False
+        pool, _ = self._pool(key)
+        with self._lock:
+            hit = pool.get(key)
+            if hit is None:
+                return False
+            if hit[3] == (view.uid, view.mutations):
+                return True
+        # stamp drifted: fall back to the exact generation walk (done
+        # outside the pool lock — it touches fragment containers)
+        gens = self._fragment_gens(idx, field_name, key[-1], view_name,
+                                   view=view)
+        if gens is None:
+            return False
+        with self._lock:
+            hit = pool.get(key)
+            return hit is not None and hit[0] == gens
+
+    def rows_chunk_resident(self, idx, field_name, row_chunk, shards,
+                            view_name=VIEW_STANDARD):
+        """Would rows_stack() serve this chunk from the rows pool?"""
+        key = ("rows", idx.name, field_name, view_name, tuple(row_chunk),
+               tuple(shards))
+        return self._probe(key, idx, field_name, view_name)
+
+    def bsi_stack_resident(self, idx, field_name, shards):
+        """Would bsi_stack() serve this field's plane stack from HBM?"""
+        field = idx.field(field_name)
+        if field is None:
+            return False
+        key = ("bsi", idx.name, field_name, field.options.bit_depth,
+               tuple(shards))
+        return self._probe(key, idx, field_name, field.bsi_view_name())
+
+    def residency_probe(self, idx, call, shards):
+        """Host-only coverage + HBM residency of a bitmap call tree:
+
+        {covered, leaves, resident, resident_bytes, missing_bytes,
+         extra_kernels}
+
+        covered mirrors _gather's verdict (same signature walk); per
+        interned leaf the probe reports whether its device stack(s) are
+        already resident and how many bytes a cold build would upload.
+        extra_kernels counts dispatches _gather itself would issue on
+        top of the consumer's own kernel (bsi_condition masks,
+        time_union folds) so estimates don't undercount BSI/time trees."""
+        shards = tuple(shards)
+        out = {"covered": False, "leaves": 0, "resident": 0,
+               "resident_bytes": 0, "missing_bytes": 0,
+               "extra_kernels": {}}
+        leaves = {}
+        sig = self.signature(idx, call, leaves)
+        if sig is None or not leaves:
+            return out
+        out["covered"] = True
+        out["leaves"] = len(leaves)
+        plane = self._padded_len(shards) * WORDS_PER_ROW * 4
+        for key in leaves:
+            if key[0] == "bsicond":
+                resident, nbytes = self._probe_bsicond(idx, key, shards,
+                                                       plane, out)
+            elif key[0] == "timerow":
+                resident, nbytes = self._probe_timerow(idx, key, shards,
+                                                       plane, out)
+            else:
+                _, field_name, row_id = key
+                leaf_key = ("leaf", idx.name, field_name, row_id, shards)
+                resident = self._probe(leaf_key, idx, field_name,
+                                       VIEW_STANDARD)
+                nbytes = plane
+            if resident:
+                out["resident"] += 1
+                out["resident_bytes"] += nbytes
+            else:
+                out["missing_bytes"] += nbytes
+        return out
+
+    def _probe_bsicond(self, idx, key, shards, plane, out):
+        """(resident, cold_bytes) of one condition leaf; counts the
+        bsi_condition dispatch the gather would add."""
+        from .bsicond import (
+            BsiConditionError,
+            bsi_condition_plan,
+            condition_from_key,
+        )
+
+        _, field_name, op, vals = key
+        field = idx.field(field_name)
+        if field is None:
+            return False, 0
+        try:
+            plan = bsi_condition_plan(
+                field.options, condition_from_key(op, vals))
+        except BsiConditionError:
+            return False, 0
+        if plan[0] == "empty":
+            return True, 0  # constant zeros, nothing uploaded
+        if plan[0] == "notnull":
+            return self.rows_chunk_resident(
+                idx, field_name, (BSI_EXISTS_BIT,), shards,
+                view_name=field.bsi_view_name()), plane
+        ek = out["extra_kernels"]
+        ek["bsi_condition"] = ek.get("bsi_condition", 0) + 1
+        depth = field.options.bit_depth
+        return (self.bsi_stack_resident(idx, field_name, shards),
+                (depth + 2) * plane)
+
+    def _probe_timerow(self, idx, key, shards, plane, out):
+        """(resident, cold_bytes) of one time-range leaf: one cached
+        single-row chunk per locally-present quantum view, plus a
+        time_union dispatch when more than one contributes."""
+        _, field_name, row_id, views = key
+        field = idx.field(field_name)
+        if field is None:
+            return False, 0
+        present = [v for v in views if field.view(v) is not None]
+        if len(present) > 1:
+            ek = out["extra_kernels"]
+            ek["time_union"] = ek.get("time_union", 0) + 1
+        resident = all(
+            self.rows_chunk_resident(idx, field_name, (row_id,), shards,
+                                     view_name=v)
+            for v in present)
+        return resident, len(present) * plane
+
+    def kernel_profile(self):
+        """Per-family dispatch counters snapshot ({family: {count,
+        seconds, bytes_in, bytes_out}}) — the cost model's "measured"
+        source and the analyze path's before/after delta basis."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._kernels.items()}
+
 
 # Backwards-compatible name (the evaluator originally covered Count only).
 StackedCountEvaluator = StackedEvaluator

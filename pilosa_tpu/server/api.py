@@ -298,6 +298,8 @@ class API:
         prof = None
         if not (options is not None and options.remote) and (
                 (options is not None and options.profile)
+                or (options is not None
+                    and getattr(options, "explain", None) == "analyze")
                 or self.long_query_time is not None):
             prof = profile_mod.begin(
                 index_name, pql if isinstance(pql, str) else str(pql),
@@ -389,9 +391,23 @@ class API:
             flightrec.record("query.slow", index=index_name,
                              seconds=round(elapsed, 3), pql=q[:200])
             if prof is not None:
+                # trace= and plan= ride ahead of profile=, which stays
+                # the LAST field: consumers parse the profile JSON as
+                # everything after "profile=" (tests pin this format)
+                # analyze queries stamp a full summary (with ! marking
+                # misestimated ops); otherwise derive one from whatever
+                # strategy notes the decision points emitted
+                plan = prof.tag("plan_summary")
+                if not plan:
+                    strategies = prof.tag("strategies")
+                    plan = ",".join(
+                        f"{s.get('op', '?')}={s.get('strategy', '?')}"
+                        for s in strategies) if strategies else "-"
                 self.logger.printf(
-                    "%.03fs SLOW QUERY index=%s %s profile=%s", elapsed,
-                    index_name, q[:500], _json.dumps(prof.to_dict()))
+                    "%.03fs SLOW QUERY index=%s %s trace=%s plan=%s "
+                    "profile=%s", elapsed, index_name, q[:500],
+                    prof.root.trace_id, plan,
+                    _json.dumps(prof.to_dict()))
             else:
                 self.logger.printf(
                     "%.03fs SLOW QUERY index=%s %s", elapsed, index_name,
@@ -952,6 +968,8 @@ class API:
     def _node_observability(self):
         """Compact local HBM + kernel summary for /status (totals only —
         the full rankings live at /debug/hbm and /debug/kernels)."""
+        from ..exec import plan as plan_mod
+
         local = getattr(self.executor, "local", self.executor)
         if not hasattr(local, "hbm_stats"):
             return None
@@ -965,6 +983,7 @@ class API:
                 kind: {"count": v["count"],
                        "seconds": round(v["seconds"], 6)}
                 for kind, v in sorted(kernels.items())},
+            "plans": plan_mod.stats(),
         }
 
     #: peer observability fetches must never wedge a /status response
@@ -980,7 +999,7 @@ class API:
                 client.timeout = self.OBSERVABILITY_PEER_TIMEOUT
             hbm = client.debug_hbm(top=0)
             kernels = client.debug_kernels(costs=False).get("kernels", {})
-            return {
+            out = {
                 "hbm": {k: hbm.get(k) for k in (
                     "total_bytes", "stack_bytes", "stack_entries",
                     "rows_stack_bytes", "rows_stack_entries")},
@@ -989,6 +1008,10 @@ class API:
                            "seconds": round(v.get("seconds", 0.0), 6)}
                     for kind, v in sorted(kernels.items())},
             }
+            plans = client.debug_plans(limit=0)
+            out["plans"] = {k: plans.get(k) for k in
+                            ("retained", "misestimates_flagged")}
+            return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}
 

@@ -100,11 +100,13 @@ class Client:
 
     def query(self, index, pql, shards=None, remote=False,
               exclude_row_attrs=False, exclude_columns=False,
-              profile=False):
+              profile=False, explain=None):
         """(reference: InternalClient.QueryNode http/client.go:268; remote
         marks node-to-node fan-out requests that must not re-fan-out;
         profile asks the server to return the query's span-tree profile
-        alongside the results)"""
+        alongside the results; explain="plan" returns the annotated plan
+        WITHOUT executing, explain="analyze" executes and returns the
+        plan with actual costs grafted on)"""
         path = f"/index/{index}/query"
         params = []
         if shards is not None:
@@ -117,6 +119,8 @@ class Client:
             params.append("excludeColumns=true")
         if profile:
             params.append("profile=true")
+        if explain:
+            params.append(f"explain={explain}")
         if params:
             path += "?" + "&".join(params)
         return self._request(
@@ -192,6 +196,14 @@ class Client:
         """Per-node kernel attribution; costs=False skips the lazy
         cost_analysis compile on the peer."""
         path = "/debug/kernels" + ("" if costs else "?costs=false")
+        return self._request("GET", path)
+
+    def debug_plans(self, limit=None):
+        """The peer's retained (misestimated) EXPLAIN ANALYZE plans +
+        misestimate counters; limit=0 fetches counters only."""
+        path = "/debug/plans"
+        if limit is not None:
+            path += f"?limit={int(limit)}"
         return self._request("GET", path)
 
     def debug_flightrecorder(self, limit=None):

@@ -73,7 +73,8 @@ class PilosaHTTPServer:
             Route("POST", r"/index/(?P<index>[^/]+)/query",
                   self._post_query,
                   args=("shards", "remote", "columnAttrs",
-                        "excludeRowAttrs", "excludeColumns", "profile")),
+                        "excludeRowAttrs", "excludeColumns", "profile",
+                        "explain")),
             Route("POST",
                   r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
                   self._post_import,
@@ -144,6 +145,8 @@ class PilosaHTTPServer:
             Route("GET", r"/metrics", self._get_metrics),
             Route("GET", r"/debug/vars", self._get_debug_vars),
             Route("GET", r"/debug/queries", self._get_debug_queries),
+            Route("GET", r"/debug/plans", self._get_debug_plans,
+                  args=("limit",)),
             Route("GET", r"/debug/traces", self._get_debug_traces),
             Route("GET", r"/debug/flightrecorder",
                   self._get_flightrecorder, args=("limit",)),
@@ -235,6 +238,18 @@ class PilosaHTTPServer:
         column_attrs = \
             req.query.get("columnAttrs", ["false"])[0] == "true"
         want_profile = req.query.get("profile", ["false"])[0] == "true"
+        # ?explain=true|plan plans without executing; ?explain=analyze
+        # executes and grafts actual costs (see exec/plan.py)
+        explain = None
+        raw_explain = req.query.get("explain", [None])[0]
+        if raw_explain is not None:
+            explain = {"true": "plan", "plan": "plan",
+                       "analyze": "analyze",
+                       "false": None}.get(raw_explain.lower(), "bad")
+            if explain == "bad":
+                raise ApiError(
+                    f"explain must be true|plan|analyze, "
+                    f"got {raw_explain!r}")
         options = ExecOptions(
             remote=req.query.get("remote", ["false"])[0] == "true",
             column_attrs=column_attrs,
@@ -242,10 +257,15 @@ class PilosaHTTPServer:
                 "excludeColumns", ["false"])[0] == "true",
             exclude_row_attrs=req.query.get(
                 "excludeRowAttrs", ["false"])[0] == "true",
-            profile=want_profile)
+            profile=want_profile, explain=explain)
         results = self.api.query(
             req.params["index"], pql, shards=shards, options=options)
         out = {"results": [result_to_json(r) for r in results]}
+        if explain is not None:
+            from ..exec import plan as plan_mod
+
+            # the executor stashed this thread's plan envelope
+            out["plan"] = plan_mod.take_last()
         if want_profile:
             from ..utils import profile as profile_mod
 
@@ -554,6 +574,20 @@ class PilosaHTTPServer:
         from ..utils import profile as profile_mod
 
         return profile_mod.recent()
+
+    def _get_debug_plans(self, req):
+        """Misestimated EXPLAIN ANALYZE plans, newest first (the ring
+        exec/plan.py retains when actual cost deviates from the estimate
+        past the configured factor), plus the cumulative flag counters.
+        ?limit=0 returns counters only — the coordinator's /status
+        observability roll-up polls peers that way."""
+        from ..exec import plan as plan_mod
+
+        limit = self._q1(req, "limit")
+        out = dict(plan_mod.stats())
+        out["plans"] = plan_mod.recent(
+            limit=int(limit) if limit is not None else None)
+        return out
 
     def _get_debug_traces(self, req):
         """Dump of the retained span ring when an InMemoryTracer is

@@ -82,6 +82,17 @@ class QueryProfile:
         with self._lock:
             self._tags[key] = value
 
+    def note(self, key, value):
+        """Append to a LIST-valued profile tag (e.g. the per-op strategy
+        records the executor's decision points emit) — `add` sums and
+        `set_tag` overwrites; ordered events need neither."""
+        with self._lock:
+            self._tags.setdefault(key, []).append(value)
+
+    def tag(self, key, default=None):
+        with self._lock:
+            return self._tags.get(key, default)
+
     # -- lifecycle -----------------------------------------------------------
 
     def begin(self):

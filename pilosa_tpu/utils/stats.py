@@ -114,6 +114,14 @@ class StatsClient:
             return {k: (v[0], v[1], tuple(v[2]))
                     for k, v in self._timings.items()}
 
+    def timing_summary(self, name):
+        """{(name, tags): (count, sum)} for ONE timing family — the
+        explain cost model reads `kernel_seconds{kernel}` means without
+        copying every histogram's buckets."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._timings.items()
+                    if k[0] == name}
+
     def prometheus_text(self):
         """Prometheus exposition format (reference: prometheus/prometheus.go
         + /metrics route http/handler.go:282): escaped label values, one
