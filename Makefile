@@ -14,9 +14,9 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_bench_orchestrator.py
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
-	test-flightrec test-explain lint bench-cpu
+	test-flightrec test-devhealth test-explain lint bench-cpu
 
-test: test-core test-distributed test-flightrec test-explain
+test: test-core test-distributed test-flightrec test-devhealth test-explain
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -29,6 +29,12 @@ test-distributed:
 # exactness, kernel attribution, and the /debug endpoints serving them.
 test-flightrec:
 	$(PY) -m pytest tests/test_flightrec.py $(PYTEST_FLAGS)
+
+# Device-link health surface: canary prober state machine, readiness
+# gating (/readyz + query fail-fast 503), and the dispatch-phase RTT
+# decomposition behind /debug/dispatch and ANALYZE actuals.
+test-devhealth:
+	$(PY) -m pytest tests/test_devhealth.py $(PYTEST_FLAGS)
 
 # EXPLAIN/ANALYZE surface: plan trees, the cost model, misestimate
 # flagging + the /debug/plans ring, and cluster sub-plan aggregation.

@@ -269,6 +269,9 @@ def main():
             "served_pct_of_kernel": round(
                 100 * served["served_qps"] / qps, 1)
             if "served_qps" in served else None,
+            # continuous canary prober roll-up (state machine + last
+            # RTT) — present when the orchestrator child started one
+            "device_link": _device_link_tag(),
         },
     }))
 
@@ -290,7 +293,16 @@ def main():
 # success, else the last error seen, so BENCH_r{N}.json never comes up
 # empty. Env knobs (mainly for tests): PILOSA_TPU_BENCH_BUDGET (total s),
 # PILOSA_TPU_BENCH_PROBE (probe s), PILOSA_TPU_BENCH_ATTEMPTS,
-# PILOSA_TPU_BENCH_FAKE (child stub: ok|error|hang|hang_after_probe).
+# PILOSA_TPU_BENCH_FAKE (child stub: ok|error|hang|hang_after_probe|
+# crash|tpu_hang|device_down), PILOSA_TPU_BENCH_DEVPROBE (child
+# device-link canary interval s; 0 disables).
+#
+# Device-link health (utils.devhealth): once the child passes its probe
+# it starts a continuous canary prober; the parent polls the child's
+# /debug/device endpoint during the main phase and kills the attempt
+# within ~one probe interval of the link going DOWN — a tunnel that dies
+# mid-measurement costs seconds, not the full-run deadline. Every error
+# record is tagged with the prober's final state + last canary RTT.
 
 PROBE_MARKER = "__PILOSA_BENCH_PROBE_OK__"
 DEBUG_MARKER = "__PILOSA_BENCH_DEBUG__:"
@@ -314,6 +326,45 @@ def _announce_debug_server() -> None:
         pass
 
 
+def _start_prober() -> None:
+    """Start the continuous device-link canary (utils.devhealth) for the
+    rest of the attempt. The flightrec debug server (already announced)
+    serves its state at /debug/device, so the parent can fail the
+    attempt fast instead of waiting out the full-run deadline when the
+    tunnel dies mid-measurement. Interval 0 disables. Never fatal."""
+    try:
+        interval = float(os.environ.get("PILOSA_TPU_BENCH_DEVPROBE", "1"))
+        if interval <= 0:
+            return
+        from pilosa_tpu.utils import devhealth
+
+        devhealth.configure(
+            interval=interval,
+            deadline=float(os.environ.get(
+                "PILOSA_TPU_BENCH_DEVPROBE_DEADLINE", "5")))
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
+def _device_link_tag():
+    """Compact {state, last_canary_rtt_ms} from the in-process prober,
+    or None when it never started. Attached to the child's own error
+    records (the parent handles kill-path tagging via HTTP)."""
+    try:
+        from pilosa_tpu.utils import devhealth
+
+        s = devhealth.summary()
+        last = s.get("last") or {}
+        rtt = last.get("rtt_seconds")
+        return {
+            "state": s.get("state"),
+            "last_canary_rtt_ms": round(rtt * 1000, 3)
+            if rtt is not None else None,
+        }
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _child() -> None:
     """One bench attempt: probe (backend init + trivial jit), marker,
     then the full measurement. Runs inside its own process; the parent
@@ -335,6 +386,7 @@ def _child() -> None:
     jax.devices()  # the observed hang point: tunnel backend init
     int(jax.jit(lambda v: v + 1)(jnp.int32(1)))  # trivial jit round trip
     print(PROBE_MARKER, file=sys.stderr, flush=True)
+    _start_prober()
     main()
 
 
@@ -343,8 +395,19 @@ def _child_fake(mode: str) -> None:
     without jax: ok | error | hang | hang_after_probe | crash (dies
     before the probe, like a tunnel import blowing up) | tpu_hang
     (hangs unless the parent retargeted it at cpu — exercises the
-    cpu-fallback leg)."""
+    cpu-fallback leg) | device_down (passes the probe, then its canary
+    prober wedges — exercises the parent's DOWN fail-fast)."""
     _announce_debug_server()
+    if mode == "device_down":
+        # Canary that outlives its deadline every probe: LIVE ->
+        # DEGRADED -> DOWN in ~down_after probe intervals; the process
+        # itself then hangs like a wedged measurement.
+        from pilosa_tpu.utils import devhealth
+
+        devhealth.configure(canary=lambda: time.sleep(60),
+                            interval=0.1, deadline=0.2)
+        print(PROBE_MARKER, file=sys.stderr, flush=True)
+        time.sleep(3600)
     if mode == "crash":
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0, "error": "fake crash"}))
@@ -434,6 +497,21 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         snap["events"] = snap.get("events", [])[-40:]
         return snap
 
+    def fetch_device():
+        """Child's device-link prober snapshot (same debug port serves
+        /debug/device). None when no port yet or the child is gone."""
+        if debug_port[0] is None:
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{debug_port[0]}/debug/device",
+                    timeout=2) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — the child may be truly wedged
+            return None
+
     def pump_out():
         for line in proc.stdout:
             out_lines.append(line)
@@ -453,6 +531,7 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
               flush=True)
         phase = "main" if probe_ok.is_set() else "probe"
         tail = fetch_flightrec()
+        dev = fetch_device()
         proc.kill()
         proc.wait()
         te.join(timeout=5)
@@ -469,6 +548,14 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         rec["phase"] = phase
         if tail is not None:
             rec["flightrec"] = tail
+        if dev is not None:
+            last = dev.get("last") or {}
+            rtt = last.get("rtt_seconds")
+            rec["device_link"] = {
+                "state": dev.get("state"),
+                "last_canary_rtt_ms": round(rtt * 1000, 3)
+                if rtt is not None else None,
+            }
         return rec
 
     t0 = time.perf_counter()
@@ -489,12 +576,21 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
     if not exited_early:
         # Full-run deadline = budget actually left, not budget minus the
         # probe's worst case — a 5s probe must not forfeit 70s of bench
-        # time.
-        try:
-            proc.wait(
-                timeout=max(remaining - (time.perf_counter() - t0), 5.0))
-        except subprocess.TimeoutExpired:
-            return kill("full-run deadline")
+        # time. While waiting, poll the child's device-link prober: a
+        # tunnel that goes DOWN mid-measurement kills the attempt within
+        # ~a second instead of burning the rest of the budget.
+        full_deadline = time.perf_counter() + max(
+            remaining - (time.perf_counter() - t0), 5.0)
+        while proc.poll() is None:
+            if time.perf_counter() >= full_deadline:
+                return kill("full-run deadline")
+            dev = fetch_device()
+            if dev is not None and dev.get("state") == "DOWN":
+                return kill("device link DOWN (canary probes failing)")
+            try:
+                proc.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                pass
     te.join(timeout=5)
     to.join(timeout=5)
     rec = _last_record(out_lines)
@@ -606,10 +702,14 @@ if __name__ == "__main__":
             _child()
         except Exception as exc:  # noqa: BLE001 — child-level last resort
             traceback.print_exc(file=sys.stderr)
-            print(json.dumps({
+            err = {
                 "metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
                 "error": f"{type(exc).__name__}: {exc}",
-            }), flush=True)
+            }
+            dl = _device_link_tag()
+            if dl is not None:
+                err["device_link"] = dl
+            print(json.dumps(err), flush=True)
             sys.exit(1)
     else:
         orchestrate()

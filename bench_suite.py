@@ -417,6 +417,14 @@ def measure_served_1b(n_shards=954, workers=256, n_queries=4096,
             "queries_per_dispatch": round(batched / max(batches, 1), 1),
             "plan_nodes": sum(_nodes(c) for c in env["calls"]),
             "plan_strategy": env["calls"][0].get("strategy"),
+            # per-kernel dispatch-phase RTT decomposition (lock_wait /
+            # transfer_in / compile / dispatch_ack / sync seconds) —
+            # rides the BENCH record so "65ms RTT" is attributable
+            "dispatch_phases": {
+                family: {ph: round(v["seconds"], 6)
+                         for ph, v in fam.items()}
+                for family, fam in
+                e.dispatch_phase_stats()["phases"].items()},
         }
     finally:
         holder.close()
@@ -851,6 +859,169 @@ def bench_flightrec_overhead():
 
 # ---------------------------------------------------------------- config 9
 
+def bench_devhealth_overhead():
+    """Device-link health + dispatch-phase decomposition acceptance leg.
+
+    Three claims, one JSON line:
+    1. The always-on per-dispatch phase clock (marks + phase
+       attribution) costs <2% of an api_nop query — microbenched like
+       flightrec_overhead's per-dispatch probe. The opt-in canary
+       prober's cost (it holds the dispatch lock for one canary RTT per
+       probe interval) is published as lock-occupancy %, not gated: it
+       is a deployment choice, not an always-on default.
+    2. The per-family phase decomposition sums to the measured kernel
+       wall within 5% (exact by construction — the assert catches
+       wiring regressions, e.g. a dispatch site missing its marks).
+    3. A synthetic hung dispatch (canary wedged behind a held
+       _DISPATCH_LOCK) flips /readyz to 503 within ~two probe
+       intervals, and /readyz recovers after the lock is released.
+    """
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.exec import stacked as stacked_mod
+    from pilosa_tpu.server import PilosaHTTPServer
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import devhealth
+
+    platform, holder, api, ex = _env()
+    api.create_index("dh")
+    api.create_field("dh", "a")
+    api.create_field("dh", "b")
+    idx = holder.index("dh")
+    n_shards = 4 if platform != "cpu" else 2
+    rng = np.random.default_rng(31)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=100_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    idx.field("b").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+
+    api.executor = ex
+    st = ex._stacked
+    pql = "Count(Intersect(Row(a=1), Row(b=1)))"
+    api.query("dh", pql)  # warm stacks + compile
+
+    # the real canary through the real lock: its RTT bounds what one
+    # probe steals from serving per interval
+    canary_s = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        devhealth.default_canary()
+        canary_s.append(time.perf_counter() - t0)
+    canary_ms = float(np.percentile(canary_s, 50)) * 1000
+
+    n_q = 50 if platform == "cpu" else 200
+    d0 = st.cache_stats()
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("dh", pql)
+    enabled_ms = (time.perf_counter() - t0) / n_q * 1000
+    d1 = st.cache_stats()
+    disp_per_q = max(1, (d1["dispatches"] - d0["dispatches"]) // n_q)
+
+    # claim 2: per-family phase seconds (minus lock_wait) vs kernel wall
+    phases = st.dispatch_phases()
+    prof = st.kernel_profile()
+    assert phases, "no dispatch phases recorded"
+    worst_err_pct = 0.0
+    for family, fam in phases.items():
+        wall = prof.get(family, {}).get("seconds", 0.0)
+        if wall <= 0:
+            continue
+        total = sum(p["seconds"] for name, p in fam.items()
+                    if name != "lock_wait")
+        err_pct = abs(total - wall) / wall * 100
+        worst_err_pct = max(worst_err_pct, err_pct)
+        assert err_pct < 5.0, (
+            f"{family}: phase sum {total:.6f}s vs kernel wall "
+            f"{wall:.6f}s ({err_pct:.2f}% apart)")
+
+    # claim 1: per-dispatch phase instrumentation microbenchmark —
+    # exactly what _locked_dispatch added (clock + 2 marks + attribution)
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        ph = stacked_mod._PhaseClock(time.perf_counter())
+        ph.mark("dispatch_ack")
+        ph.mark("sync")
+        st._note_phases(
+            "bench_probe",
+            [("lock_wait", 0.0)] + [tuple(p) for p in ph.phases])
+    per_dispatch_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = per_dispatch_ns * disp_per_q / 1e6 / enabled_ms * 100
+    assert overhead_pct < 2.0, (
+        f"dispatch-phase instrumentation costs {overhead_pct:.3f}% of an "
+        "api_nop query — no longer an always-on-safe default")
+    prober_lock_pct = canary_ms / (devhealth.DEFAULT_INTERVAL * 1000) * 100
+
+    # claim 3: wedge the canary behind a held dispatch lock -> DOWN ->
+    # /readyz 503 within ~two probe intervals; recovery after release
+    srv = PilosaHTTPServer(api, host="127.0.0.1", port=0)
+    srv.start()
+
+    def readyz_code():
+        try:
+            with urllib.request.urlopen(
+                    srv.address + "/readyz", timeout=2) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    interval, deadline = 0.25, 0.05
+    devhealth.configure(interval=interval, deadline=deadline,
+                        down_after=2, jitter=0.0)
+    try:
+        flip_s = recover_s = None
+        t0 = time.perf_counter()
+        with st._locked_dispatch("synthetic_stall"):
+            while time.perf_counter() - t0 < interval * 20:
+                if readyz_code() == 503:
+                    flip_s = time.perf_counter() - t0
+                    break
+                time.sleep(0.02)
+        assert flip_s is not None, (
+            f"/readyz never went 503 with the canary wedged "
+            f"{interval * 20}s behind the dispatch lock")
+        # first probe may land up to one interval after the lock is
+        # taken; DOWN needs one timed-out canary (deadline) plus one
+        # busy-runner probe slot (interval) after that
+        assert flip_s <= 2 * interval + deadline + 0.5, (
+            f"/readyz flipped after {flip_s:.3f}s — expected within two "
+            f"{interval}s probe intervals of the stall")
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < interval * 20:
+            if readyz_code() == 200:
+                recover_s = time.perf_counter() - t0
+                break
+            time.sleep(0.02)
+        assert recover_s is not None, (
+            "/readyz never recovered after the stall cleared")
+        probes = devhealth.summary()["probes"]
+    finally:
+        devhealth.stop()
+        srv.stop()
+
+    _close(holder)
+    _emit("devhealth_overhead_pct", overhead_pct, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "dispatches_per_q": disp_per_q,
+        "per_dispatch_phase_ns": round(per_dispatch_ns, 1),
+        "api_nop_enabled_ms": round(enabled_ms, 3),
+        "overhead_pct": round(overhead_pct, 4),
+        "canary_rtt_ms": round(canary_ms, 3),
+        "prober_lock_occupancy_pct": round(prober_lock_pct, 3),
+        "phase_sum_worst_err_pct": round(worst_err_pct, 4),
+        "probe_interval_s": interval,
+        "probe_deadline_s": deadline,
+        "readyz_flip_s": round(flip_s, 3),
+        "readyz_recover_s": round(recover_s, 3),
+        "probes": probes})
+
+
+# ---------------------------------------------------------------- config 10
+
 def bench_explain_overhead():
     """EXPLAIN/ANALYZE acceptance leg.
 
@@ -953,6 +1124,7 @@ CONFIGS = {
     "groupby_pairwise": bench_groupby_pairwise,
     "workpool_scaling": bench_workpool_scaling,
     "flightrec_overhead": bench_flightrec_overhead,
+    "devhealth_overhead": bench_devhealth_overhead,
     "explain_overhead": bench_explain_overhead,
 }
 

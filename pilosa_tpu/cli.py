@@ -248,6 +248,22 @@ def cmd_server(args):
             parse_duration(str(wd_deadline)), logger=_FrLogger())
     _flightrec.install_crash_handler(logger=_FrLogger())
 
+    # Device-link health prober: tiny canary dispatches through the real
+    # dispatch-lock path drive /readyz + the query fail-fast gate.
+    # Opt-in like the watchdog — when unset, the module guarantees zero
+    # canary dispatches and /readyz reports DISABLED (ready).
+    _devhealth = None
+    probe_interval = config.get("device-probe-interval")
+    if probe_interval:
+        from .utils import devhealth as _devhealth
+
+        probe_deadline = config.get("device-probe-deadline")
+        _devhealth.configure(
+            interval=parse_duration(str(probe_interval)),
+            deadline=parse_duration(str(probe_deadline))
+            if probe_deadline else _devhealth.DEFAULT_DEADLINE,
+            logger=_FrLogger())
+
     # EXPLAIN ANALYZE plan retention + misestimate threshold
     # (exec/plan.py module state, like the flight recorder above).
     prs = config.get("plan-ring-size")
@@ -374,6 +390,8 @@ def cmd_server(args):
     finally:
         if diagnostics:
             diagnostics.stop()
+        if _devhealth is not None:
+            _devhealth.stop()
         _flightrec.stop_watchdog()
         runtime_monitor.stop()
         if translate_repl:
@@ -695,7 +713,8 @@ def _apply_server_flags(config, args):
                  "replicas", "spmd_port", "long_query_time",
                  "max_writes_per_request", "tracing", "workers",
                  "flight_recorder_size", "watchdog_deadline",
-                 "plan_ring_size", "explain_misestimate_factor"):
+                 "plan_ring_size", "explain_misestimate_factor",
+                 "device_probe_interval", "device_probe_deadline"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -862,6 +881,14 @@ def main(argv=None):
                    help="flag a plan node when actual cost deviates from "
                         "the estimate by more than this factor in either "
                         "direction (default 3.0)")
+    p.add_argument("--device-probe-interval", default=None,
+                   help="device-link canary probe interval (e.g. 1s, "
+                        "500ms): background canary dispatches drive the "
+                        "LIVE/DEGRADED/DOWN readiness state at /readyz "
+                        "and /debug/device; disabled when unset")
+    p.add_argument("--device-probe-deadline", default=None,
+                   help="per-canary deadline (e.g. 5s) before a probe "
+                        "counts as a device-link failure (default 5s)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")
@@ -949,6 +976,8 @@ def main(argv=None):
     p.add_argument("--watchdog-deadline", default=None)
     p.add_argument("--plan-ring-size", type=int, default=None)
     p.add_argument("--explain-misestimate-factor", type=float, default=None)
+    p.add_argument("--device-probe-interval", default=None)
+    p.add_argument("--device-probe-deadline", default=None)
     p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)

@@ -476,6 +476,16 @@ class ClusterExecutor:
                     result = result_from_json(resp["results"][0])
                 merge_in(result)
             except Exception as e:
+                if getattr(e, "status", None) == 503:
+                    # the peer REJECTED fast (its device-link prober says
+                    # DOWN) rather than timing out — name the node in the
+                    # recorder so a cluster slowdown is attributable (the
+                    # coordinator's /status?observability=true roll-up
+                    # shows the same state via /debug/device)
+                    from ..utils import flightrec
+
+                    flightrec.record("cluster.node_unready", node=node.id,
+                                     index=idx.name, error=str(e))
                 # retry each shard on its next replica (reference:
                 # mapReduce error path executor.go:2490-2503)
                 retried = False

@@ -219,6 +219,11 @@ class Executor:
         """Per-kernel attribution (see StackedEvaluator.kernels_snapshot)."""
         return self._stacked.kernels_snapshot(include_costs=include_costs)
 
+    def dispatch_phase_stats(self):
+        """Per-kernel dispatch-phase RTT decomposition (see
+        StackedEvaluator.dispatch_phases)."""
+        return {"phases": self._stacked.dispatch_phases()}
+
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name, query, shards=None, options=None):
@@ -341,6 +346,7 @@ class Executor:
         notes = self._explain_tls.notes = []
         before = self._stacked.cache_stats()
         kern_before = self._stacked.kernel_profile()
+        phases_before = self._stacked.dispatch_phases()
         t0 = _time.perf_counter()
         try:
             result = self.execute_call(idx, call, shards, opt)
@@ -349,7 +355,9 @@ class Executor:
         wall = _time.perf_counter() - t0
         plan_mod.graft_actual(
             node, wall, before, self._stacked.cache_stats(),
-            kern_before, self._stacked.kernel_profile(), strategies=notes)
+            kern_before, self._stacked.kernel_profile(), strategies=notes,
+            phases_before=phases_before,
+            phases_after=self._stacked.dispatch_phases())
         return result, node
 
     def _note_strategy(self, op, strategy, **detail):

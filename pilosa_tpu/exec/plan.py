@@ -814,12 +814,17 @@ class Planner:
 
 
 def graft_actual(node, wall_seconds, before, after, kernel_before,
-                 kernel_after, strategies=None):
+                 kernel_after, strategies=None, phases_before=None,
+                 phases_after=None):
     """Attach measured actuals (stacked cache_stats + per-family kernel
     seconds deltas) onto one TOP-LEVEL plan node, then compare against
     the estimate. Deltas are exact when queries are serialized (the
     acceptance path) and order-of-magnitude under concurrency — same
-    caveat as the QueryProfile counter deltas."""
+    caveat as the QueryProfile counter deltas. phases_before/after are
+    StackedEvaluator.dispatch_phases() snapshots; when given, the actual
+    gains a per-phase RTT decomposition (`phase_seconds`) so the cost
+    model can price lock wait / compile / dispatch ack / device sync
+    separately from kernel wall."""
     actual = {
         "wall_seconds": round(wall_seconds, 6),
         "dispatches": after["dispatches"] - before["dispatches"],
@@ -843,6 +848,18 @@ def graft_actual(node, wall_seconds, before, after, kernel_before,
     actual["kernel_wall_seconds"] = round(k_wall, 6)
     if k_by_family:
         actual["kernels"] = k_by_family
+    if phases_before is not None and phases_after is not None:
+        phase_seconds = {}
+        for family, fam in phases_after.items():
+            prev_fam = phases_before.get(family, {})
+            for phase, p in fam.items():
+                prev = prev_fam.get(phase, {"count": 0, "seconds": 0.0})
+                ds = p["seconds"] - prev["seconds"]
+                if p["count"] - prev["count"] > 0:
+                    phase_seconds[phase] = round(
+                        phase_seconds.get(phase, 0.0) + ds, 6)
+        if phase_seconds:
+            actual["phase_seconds"] = phase_seconds
     if strategies:
         mine = [s for s in strategies if s.get("op") == node.op]
         if mine:

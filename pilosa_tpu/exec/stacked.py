@@ -166,6 +166,41 @@ def _launch_barrier(out):
     return out
 
 
+#: dispatch-phase taxonomy (GET /debug/dispatch): lock_wait is measured
+#: by _locked_dispatch itself; the others are marked by the dispatch
+#: sites between the operations they time. transfer_in exists for sites
+#: that explicitly stage host data under the lock — on the current
+#: paths dense plane uploads happen on the upload path OUTSIDE the
+#: dispatch lock (attributed via planes_uploaded / hbm ledger), so the
+#: phase is normally absent. dispatch_ack is relabeled "compile" on a
+#: program's first call (detected via the kernel arg-spec cache) because
+#: trace+compile dominates that call's fn() wall.
+DISPATCH_PHASES = ("lock_wait", "transfer_in", "compile", "dispatch_ack",
+                   "sync")
+
+
+class _PhaseClock:
+    """Phase marks within one locked dispatch. `mark(phase)` attributes
+    the time since the previous mark (or lock acquisition) to `phase`;
+    _locked_dispatch folds any residual into the last mark on exit so
+    the per-phase seconds sum EXACTLY to the dispatch wall (the
+    bench_suite devhealth leg asserts the 5% version of this)."""
+
+    __slots__ = ("_t", "compiling", "phases")
+
+    def __init__(self, t1, compiling=False):
+        self._t = t1
+        self.compiling = compiling
+        self.phases = []
+
+    def mark(self, phase):
+        now = time.perf_counter()
+        if phase == "dispatch_ack" and self.compiling:
+            phase = "compile"
+        self.phases.append([phase, now - self._t])
+        self._t = now
+
+
 def _device_get_batch(payloads):
     """GroupCommit `process` for plain result fetches: payloads are
     tuples of device values; ONE device_get resolves them all."""
@@ -375,6 +410,11 @@ class StackedEvaluator:
         self._kernels = {}
         self._fn_specs = {}
         self._kernel_costs = {}
+        # Dispatch-phase decomposition: kind -> {phase: {count, seconds}}
+        # fed by _locked_dispatch's phase clock (GET /debug/dispatch) —
+        # splits the per-dispatch RTT into lock_wait / transfer_in /
+        # compile / dispatch_ack / sync so "65ms RTT" is attributable.
+        self._dispatch_phases = {}
         # Incremental-maintenance observability: a patch re-uploads only
         # the drifted shards' planes instead of the whole stack; tests
         # assert planes_uploaded stays O(changed shards) under writes.
@@ -834,9 +874,12 @@ class StackedEvaluator:
         with self._locked_dispatch(
                 "bsi_condition",
                 nbytes_in=(planes.size + sign.size + exists.size) * 4,
-                nbytes_out=sign.size * 4):
-            return _launch_barrier(
-                apply_bsi_condition(plan, planes, sign, exists))
+                nbytes_out=sign.size * 4) as ph:
+            out = apply_bsi_condition(plan, planes, sign, exists)
+            ph.mark("dispatch_ack")
+            out = _launch_barrier(out)
+            ph.mark("sync")
+            return out
 
     def time_row_stack(self, idx, key, shards):
         """[S, W] union of one row across the quantum-view cover (the
@@ -866,11 +909,16 @@ class StackedEvaluator:
         # the evaluator's own union fold: one fn-cache, one operator impl
         sig = ("|", tuple(("leaf", i) for i in range(len(stacks))))
         self.dispatches += 1
+        fn = self._plane_fn(sig, len(stacks))
         with self._locked_dispatch(
                 "time_union",
                 nbytes_in=sum(s.size for s in stacks) * 4,
-                nbytes_out=stacks[0].size * 4):
-            return _launch_barrier(self._plane_fn(sig, len(stacks))(*stacks))
+                nbytes_out=stacks[0].size * 4, fn=fn) as ph:
+            out = fn(*stacks)
+            ph.mark("dispatch_ack")
+            out = _launch_barrier(out)
+            ph.mark("sync")
+            return out
 
     def row_chunk_size(self, shards):
         """Rows per [R, S, W] chunk under the CHUNK_BYTES budget."""
@@ -878,42 +926,69 @@ class StackedEvaluator:
             1, CHUNK_BYTES // (self._padded_len(shards) * WORDS_PER_ROW * 4))
 
     @contextlib.contextmanager
-    def _locked_dispatch(self, kind, nbytes_in=0, nbytes_out=0):
+    def _locked_dispatch(self, kind, nbytes_in=0, nbytes_out=0, fn=None):
         """Hold the process-wide dispatch lock around one device launch.
 
         Always on (cheap — a few dict/deque ops vs ms-scale kernels;
-        the flightrec bench leg holds the total under 2% of the api_nop
-        path): per-kernel wall/bytes attribution (`kernel_seconds{kernel}`
-        histograms, /debug/kernels), dispatch start/end flight-recorder
-        events, and a watchdog op covering the lock hold — a dispatch
-        that never returns (the r05 tunnel wedge) trips the stall dump
-        instead of hanging silently. With a QueryProfile active it
-        additionally measures how long THIS query waited on the lock vs
-        how long its kernel held it, emits a `stacked.kernel` child span
-        (op=kind), and accumulates the profile's lock-wait/kernel-wall
-        totals — the two numbers that split "slow query" into contention
-        vs compute."""
+        the flightrec + devhealth bench legs hold the total under 2% of
+        kernel wall): per-kernel wall/bytes attribution
+        (`kernel_seconds{kernel}` histograms, /debug/kernels), dispatch
+        start/end flight-recorder events, and a watchdog op covering the
+        lock hold — a dispatch that never returns (the r05 tunnel wedge)
+        trips the stall dump instead of hanging silently. With a
+        QueryProfile active it additionally measures how long THIS query
+        waited on the lock vs how long its kernel held it, emits a
+        `stacked.kernel` child span (op=kind), and accumulates the
+        profile's lock-wait/kernel-wall totals — the two numbers that
+        split "slow query" into contention vs compute.
+
+        Yields a _PhaseClock: sites mark "dispatch_ack" after the
+        program call returns and "sync" after the launch barrier, so the
+        65ms dispatch RTT of BENCH r03 decomposes into where it actually
+        goes (GET /debug/dispatch, phase_* profile tags, EXPLAIN ANALYZE
+        actuals). `fn` — when it is a _wrap_spec_capture kernel — lets
+        the clock detect a first call (its key absent from the arg-spec
+        cache) and relabel dispatch_ack as compile."""
         prof = _profile.current()
         _flightrec.record("dispatch.start", kernel=kind)
         token = _flightrec.watch_begin("dispatch." + kind)
+        compiling = False
+        if fn is not None:
+            key = getattr(fn, "_spec_key", None)
+            compiling = key is not None and key not in self._fn_specs
         t0 = time.perf_counter()
         try:
             with self._dispatch_lock:
                 t1 = time.perf_counter()
+                ph = _PhaseClock(t1, compiling)
                 if prof is None:
-                    yield
+                    yield ph
                 else:
                     with _tracing.start_span("stacked.kernel",
                                              op=kind) as span:
                         if span is not None:
                             span.set_tag("lock_wait_seconds",
                                          round(t1 - t0, 6))
-                        yield
+                        yield ph
+                        if span is not None:
+                            for phase, dt in ph.phases:
+                                span.set_tag(f"phase_{phase}_seconds",
+                                             round(dt, 6))
                 t2 = time.perf_counter()
         finally:
             _flightrec.watch_end(token)
         wait, wall = t1 - t0, t2 - t1
+        # fold the residual (span bookkeeping, unmarked tails) into the
+        # last phase so the phases sum exactly to the dispatch wall; a
+        # site that never marked attributes its whole wall in one piece
+        if ph.phases:
+            ph.phases[-1][1] += t2 - ph._t
+        else:
+            ph.phases.append(["compile" if compiling else "dispatch_ack",
+                              wall])
+        phases = [("lock_wait", wait)] + [tuple(p) for p in ph.phases]
         self._note_kernel(kind, wall, nbytes_in, nbytes_out)
+        self._note_phases(kind, phases)
         _flightrec.record("dispatch.end", kernel=kind,
                           lock_wait_seconds=round(wait, 6),
                           kernel_wall_seconds=round(wall, 6))
@@ -921,6 +996,9 @@ class StackedEvaluator:
             prof.add("dispatch_lock_wait_seconds", wait)
             prof.add("kernel_wall_seconds", wall)
             prof.add("locked_dispatches", 1)
+            for phase, dt in phases:
+                if phase != "lock_wait":  # already counted above
+                    prof.add(f"phase_{phase}_seconds", dt)
 
     def _note_kernel(self, kind, wall, nbytes_in, nbytes_out):
         """Per-kernel-family attribution (see /debug/kernels)."""
@@ -940,6 +1018,31 @@ class StackedEvaluator:
             global_stats.count("kernel_bytes_in", nbytes_in, tags)
         if nbytes_out:
             global_stats.count("kernel_bytes_out", nbytes_out, tags)
+
+    def _note_phases(self, kind, phases):
+        """Per-kernel per-phase attribution (see GET /debug/dispatch)."""
+        with self._lock:
+            fam = self._dispatch_phases.get(kind)
+            if fam is None:
+                fam = self._dispatch_phases[kind] = {}
+            for phase, dt in phases:
+                p = fam.get(phase)
+                if p is None:
+                    p = fam[phase] = {"count": 0, "seconds": 0.0}
+                p["count"] += 1
+                p["seconds"] += dt
+        for phase, dt in phases:
+            global_stats.timing("dispatch_phase_seconds", dt,
+                                {"kernel": kind, "phase": phase})
+
+    def dispatch_phases(self):
+        """{kernel: {phase: {count, seconds}}} snapshot — the RTT
+        decomposition behind GET /debug/dispatch and the analyze path's
+        per-phase before/after delta basis. Phase seconds other than
+        lock_wait sum to the family's kernel wall by construction."""
+        with self._lock:
+            return {k: {p: dict(v) for p, v in fam.items()}
+                    for k, fam in self._dispatch_phases.items()}
 
     # -- compiled kernels ----------------------------------------------------
 
@@ -974,6 +1077,7 @@ class StackedEvaluator:
             return fn(*args)
 
         wrapped._jit_fn = fn
+        wrapped._spec_key = key  # first-call (compile) detection
         return wrapped
 
     @staticmethod
@@ -1083,9 +1187,12 @@ class StackedEvaluator:
                     args.extend(payloads[chunk[0]][1])  # pad: repeat q0
                 with self._locked_dispatch(
                         "count",
-                        nbytes_in=sum(a.size for a in args) * 4):
+                        nbytes_in=sum(a.size for a in args) * 4,
+                        fn=fn) as ph:
                     his, los = fn(*args)
+                    ph.mark("dispatch_ack")
                     _launch_barrier((his, los))
+                    ph.mark("sync")
                 outs.append((chunk, his, los))
         flat = [a for _, h, l in outs for a in (h, l)]
         vals = jax.device_get(flat)  # ONE transfer for everything
@@ -1262,12 +1369,16 @@ class StackedEvaluator:
             return False, None
         sig, stacks = gathered
         self.dispatches += 1
+        fn = self._plane_fn(sig, len(stacks))
         with self._locked_dispatch(
                 "filter",
                 nbytes_in=sum(s.size for s in stacks) * 4,
-                nbytes_out=stacks[0].size * 4):
-            return True, _launch_barrier(
-                self._plane_fn(sig, len(stacks))(*stacks))
+                nbytes_out=stacks[0].size * 4, fn=fn) as ph:
+            out = fn(*stacks)
+            ph.mark("dispatch_ack")
+            out = _launch_barrier(out)
+            ph.mark("sync")
+            return True, out
 
     def row_counts(self, idx, field_name, row_ids, filt, shards,
                    view_name=VIEW_STANDARD):
@@ -1296,14 +1407,17 @@ class StackedEvaluator:
             self.dispatches += 1
             n_in = stack.size * 4 + (filt.size * 4 if filt is not None
                                      else 0)
-            with self._locked_dispatch("row_counts", nbytes_in=n_in):
+            with self._locked_dispatch("row_counts", nbytes_in=n_in,
+                                       fn=fn) as ph:
                 hi_lo = fn(stack, filt) if filt is not None else fn(stack)
+                ph.mark("dispatch_ack")
                 _launch_barrier(hi_lo)
                 if not cache:
                     # Transient chunks: block before building the next one
                     # so peak HBM stays ~CHUNK_BYTES instead of the whole
                     # candidate set queued in flight.
                     jax.block_until_ready(hi_lo)
+                ph.mark("sync")
             pending.append((chunk, hi_lo))
         # ONE amortized fetch for every chunk's (hi, lo) pair — shared
         # with concurrently-serving queries via the group commit
@@ -1356,14 +1470,16 @@ class StackedEvaluator:
                         + (filt.size if filt is not None else 0)) * 4
                 with self._locked_dispatch(
                         "pairwise", nbytes_in=n_in,
-                        nbytes_out=len(a_chunk) * len(b_chunk) * 8):
+                        nbytes_out=len(a_chunk) * len(b_chunk) * 8) as ph:
                     hi, lo = bitplane.pairwise_counts_hi_lo(
                         a_stack, b_stack, filt)
+                    ph.mark("dispatch_ack")
                     _launch_barrier((hi, lo))
                     if not (cache_a and cache_b):
                         # Transient tiles: bound peak HBM before the next
                         # pair (same discipline as row_counts).
                         jax.block_until_ready((hi, lo))
+                    ph.mark("sync")
                 # ONE host sync for the whole [tile, tile] matrix, shared
                 # with concurrent serving traffic via the group commit
                 vals = self._fetch_commit.submit((hi, lo),
@@ -1391,12 +1507,14 @@ class StackedEvaluator:
         self.dispatches += 1
         n_in = (planes.size + sign.size + exists.size
                 + (filt.size if filt is not None else 0)) * 4
-        with self._locked_dispatch("sum", nbytes_in=n_in):
+        with self._locked_dispatch("sum", nbytes_in=n_in, fn=fn) as ph:
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:
                 res = fn(planes, sign, exists)
+            ph.mark("dispatch_ack")
             _launch_barrier(res)
+            ph.mark("sync")
         p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = \
             self._fetch_commit.submit(tuple(res), _device_get_batch)
         pos = combine_hi_lo(p_hi, p_lo)
@@ -1424,12 +1542,14 @@ class StackedEvaluator:
         self.dispatches += 1
         n_in = (planes.size + sign.size + exists.size
                 + (filt.size if filt is not None else 0)) * 4
-        with self._locked_dispatch("minmax", nbytes_in=n_in):
+        with self._locked_dispatch("minmax", nbytes_in=n_in, fn=fn) as ph:
             if filt is not None:
                 res = fn(planes, sign, exists, filt)
             else:
                 res = fn(planes, sign, exists)
+            ph.mark("dispatch_ack")
             _launch_barrier(res)
+            ph.mark("sync")
         # amortized result fetch (group commit, like try_sum)
         empty, use_neg, bits, c_hi, c_lo = \
             self._fetch_commit.submit(tuple(res), _device_get_batch)

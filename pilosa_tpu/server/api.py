@@ -30,6 +30,9 @@ from .. import __version__
 
 class ApiError(Exception):
     status = 400
+    #: optional extra response headers ({name: value}) — the HTTP layer
+    #: emits them verbatim (e.g. Retry-After on 503)
+    headers = None
 
 
 class NotFoundError(ApiError):
@@ -38,6 +41,19 @@ class NotFoundError(ApiError):
 
 class ConflictError(ApiError):
     status = 409
+
+
+class ServiceUnavailableError(ApiError):
+    """503: the node cannot serve right now (device link DOWN). Carries
+    Retry-After so clients back off for one probe interval — by then the
+    state machine has fresh canary evidence either way."""
+    status = 503
+
+    def __init__(self, message, retry_after=None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.headers = {
+                "Retry-After": str(max(1, int(round(retry_after))))}
 
 
 def field_options_from_json(opts):
@@ -290,6 +306,20 @@ class API:
         self._validate_state()
         if self.holder.index(index_name) is None:
             raise NotFoundError(f"index not found: {index_name}")
+        # Device-link fail-fast: with the link DOWN a query would wedge
+        # behind the dispatch lock until the watchdog fires (75s+ in the
+        # r04/r05 postmortems); reject in microseconds instead. DEGRADED
+        # still serves — hysteresis keeps one flaky probe from shedding
+        # load. Applies to remote fan-out legs too: the coordinator gets
+        # a fast 503 it can surface rather than a wedged peer socket.
+        from ..utils import devhealth
+        if devhealth.is_down():
+            retry = devhealth.retry_after_seconds()
+            flightrec.record("query.rejected", index=index_name,
+                             reason="device_link_down")
+            raise ServiceUnavailableError(
+                "device link DOWN (canary probes failing); "
+                f"retry in {retry:.0f}s", retry_after=retry)
         # Profile when the request asked (?profile=true) or a slow-query
         # threshold is configured (so a slow query's log line carries the
         # full span tree, not just its total). Remote fan-out legs never
@@ -966,9 +996,11 @@ class API:
         return out
 
     def _node_observability(self):
-        """Compact local HBM + kernel summary for /status (totals only —
-        the full rankings live at /debug/hbm and /debug/kernels)."""
+        """Compact local HBM + kernel + device-link summary for /status
+        (totals only — the full rankings live at /debug/hbm,
+        /debug/kernels, and /debug/device)."""
         from ..exec import plan as plan_mod
+        from ..utils import devhealth
 
         local = getattr(self.executor, "local", self.executor)
         if not hasattr(local, "hbm_stats"):
@@ -984,6 +1016,7 @@ class API:
                        "seconds": round(v["seconds"], 6)}
                 for kind, v in sorted(kernels.items())},
             "plans": plan_mod.stats(),
+            "device_link": devhealth.summary(),
         }
 
     #: peer observability fetches must never wedge a /status response
@@ -1011,6 +1044,13 @@ class API:
             plans = client.debug_plans(limit=0)
             out["plans"] = {k: plans.get(k) for k in
                             ("retained", "misestimates_flagged")}
+            # device-link roll-up: the coordinator's /status answers
+            # "which node's tunnel is dead" without a per-node ssh
+            dev = client.debug_device(limit=0)
+            out["device_link"] = {k: dev.get(k) for k in
+                                  ("state", "state_since",
+                                   "consecutive_failures", "probes",
+                                   "last")}
             return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}

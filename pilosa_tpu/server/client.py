@@ -206,6 +206,18 @@ class Client:
             path += f"?limit={int(limit)}"
         return self._request("GET", path)
 
+    def debug_device(self, limit=None):
+        """The peer's device-link health (state machine + canary ring);
+        limit=0 fetches the state summary without the ring."""
+        path = "/debug/device"
+        if limit is not None:
+            path += f"?limit={int(limit)}"
+        return self._request("GET", path)
+
+    def debug_dispatch(self):
+        """The peer's per-kernel dispatch-phase RTT decomposition."""
+        return self._request("GET", "/debug/dispatch")
+
     def debug_flightrecorder(self, limit=None):
         """The peer's flight-recorder tail."""
         path = "/debug/flightrecorder"

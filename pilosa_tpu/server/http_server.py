@@ -13,8 +13,8 @@ from urllib.parse import parse_qs, urlparse
 
 from ..core.index import IndexOptions
 from ..core import timeq
-from .api import ApiError, NotFoundError, field_options_from_json, \
-    field_options_to_json, result_to_json
+from .api import ApiError, NotFoundError, ServiceUnavailableError, \
+    field_options_from_json, field_options_to_json, result_to_json
 
 
 class Route:
@@ -89,6 +89,8 @@ class PilosaHTTPServer:
             Route("GET", r"/schema", self._get_schema),
             Route("POST", r"/schema", self._post_schema),
             Route("GET", r"/status", self._get_status),
+            Route("GET", r"/healthz", self._get_healthz),
+            Route("GET", r"/readyz", self._get_readyz),
             Route("GET", r"/info", self._get_info),
             Route("GET", r"/version", self._get_version),
             Route("GET", r"/internal/shards/max", self._get_shards_max),
@@ -154,6 +156,9 @@ class PilosaHTTPServer:
                   args=("top",)),
             Route("GET", r"/debug/kernels", self._get_debug_kernels,
                   args=("costs",)),
+            Route("GET", r"/debug/device", self._get_debug_device,
+                  args=("limit",)),
+            Route("GET", r"/debug/dispatch", self._get_debug_dispatch),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
             Route("POST", r"/debug/pprof/profile/start",
                   self._profile_start),
@@ -388,6 +393,26 @@ class PilosaHTTPServer:
         return self.api.status(
             include_remote_observability=(
                 self._q1(req, "observability", "false") == "true"))
+
+    def _get_healthz(self, req):
+        """Liveness: the process is up and serving HTTP. Deliberately
+        ignores the device link — a dead tunnel needs draining
+        (/readyz), not a restart loop."""
+        return {"status": "ok"}
+
+    def _get_readyz(self, req):
+        """Readiness, gated on the device-link prober: LIVE, DEGRADED,
+        and DISABLED (no prober configured) serve; DOWN answers 503 +
+        Retry-After so load balancers drain the node until canary
+        probes recover."""
+        from ..utils import devhealth
+
+        state = devhealth.state()
+        if state == devhealth.DOWN:
+            raise ServiceUnavailableError(
+                f"not ready: device link {state}",
+                retry_after=devhealth.retry_after_seconds())
+        return {"status": "ok", "device_link": state}
 
     def _get_info(self, req):
         return self.api.info()
@@ -634,6 +659,24 @@ class PilosaHTTPServer:
         return local.kernel_stats(
             include_costs=self._q1(req, "costs", "true") != "false")
 
+    def _get_debug_device(self, req):
+        """Device-link health: the prober's state machine plus the full
+        canary sample ring (?limit= bounds the ring; 0 = summary only)."""
+        from ..utils import devhealth
+
+        limit = self._q1(req, "limit")
+        return devhealth.snapshot(
+            limit=int(limit) if limit is not None else None)
+
+    def _get_debug_dispatch(self, req):
+        """Per-kernel dispatch-phase RTT decomposition: where each
+        family's round trip goes (lock_wait / transfer_in / compile /
+        dispatch_ack / sync)."""
+        local = self._local_executor()
+        if not hasattr(local, "dispatch_phase_stats"):
+            raise NotFoundError("no stacked evaluator on this node")
+        return local.dispatch_phase_stats()
+
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
     #    profile.cpu config server/config.go) --------------------------------
 
@@ -827,6 +870,7 @@ class PilosaHTTPServer:
         t0 = _time.perf_counter()
         status, payload, content_type = 404, {"error": "not found"}, \
             "application/json"
+        extra_headers = None  # e.g. Retry-After on a 503
         matched = None  # Route whose pattern labels this request's metrics
         for route in self.routes:
             if route.method != handler.command:
@@ -858,6 +902,7 @@ class PilosaHTTPServer:
                         status, payload = 200, result
                 except ApiError as e:
                     status, payload = e.status, {"error": str(e)}
+                    extra_headers = e.headers
                 except Exception as e:  # internal error
                     status, payload = 500, {"error": str(e)}
                 if span is not None:
@@ -877,6 +922,9 @@ class PilosaHTTPServer:
             handler.send_response(status)
             handler.send_header("Content-Type", content_type)
             handler.send_header("Content-Length", str(len(data)))
+            if extra_headers:
+                for name, value in extra_headers.items():
+                    handler.send_header(name, value)
             if self.allowed_origins:
                 handler.send_header("Vary", "Origin")
             if cors:

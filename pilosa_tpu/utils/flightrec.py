@@ -292,15 +292,22 @@ class Watchdog:
         overdue = now - op.start
         tags = {"kind": op.kind}
         global_stats.count("watchdog_stalls", 1, tags)
+        # the device-link state splits "stall" into its two causes at a
+        # glance: DOWN/DEGRADED = dead tunnel, LIVE = lock contention or
+        # genuinely slow work (lazy import — devhealth imports stats too)
+        from . import devhealth as _devhealth
+
+        link_state = _devhealth.state()
         evt = dict(op.tags, kind=op.kind, thread=op.thread,
                    running_seconds=round(overdue, 3),
-                   deadline_seconds=op.deadline)
+                   deadline_seconds=op.deadline,
+                   device_link_state=link_state)
         if _recorder.enabled:
             _recorder.record("watchdog.stall", evt)
         self.logger.error(
             "WATCHDOG STALL: op %r on thread %s running %.3fs "
-            "(deadline %.3fs) tags=%s\n%s\n%s",
-            op.kind, op.thread, overdue, op.deadline, op.tags,
+            "(deadline %.3fs) device_link=%s tags=%s\n%s\n%s",
+            op.kind, op.thread, overdue, op.deadline, link_state, op.tags,
             _recorder.format_tail(), format_all_stacks())
 
     def _loop(self):
@@ -400,10 +407,18 @@ def install_crash_handler(logger=None):
 
 class _DebugHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        if self.path.split("?", 1)[0] != "/debug/flightrecorder":
+        path = self.path.split("?", 1)[0]
+        if path == "/debug/device":
+            # the bench parent reads the child's prober through this
+            # same bare port to diagnose (and fast-abort on) dead links
+            from . import devhealth as _devhealth
+
+            body = json.dumps(_devhealth.snapshot(limit=8)).encode()
+        elif path == "/debug/flightrecorder":
+            body = json.dumps(snapshot()).encode()
+        else:
             self.send_error(404)
             return
-        body = json.dumps(snapshot()).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

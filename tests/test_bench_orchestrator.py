@@ -132,6 +132,34 @@ def test_failure_forensics_attached_to_error_record():
     assert any(e["kind"] == "bench.child_start" for e in tail["events"])
 
 
+def test_device_down_aborts_attempt_fast():
+    # Child probes OK, then its device-link canary wedges (DOWN within
+    # ~3 fast probe intervals). The parent polls /debug/device and must
+    # kill the attempt within seconds — NOT wait out the full-run
+    # deadline — and tag the error record with the prober's verdict.
+    env_had = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # keep the cpu-fallback leg off
+    try:
+        code, rec, elapsed = run_bench(
+            "device_down", budget="60", probe="15", attempts="1")
+    finally:
+        if env_had is None:
+            del os.environ["JAX_PLATFORMS"]
+        else:
+            os.environ["JAX_PLATFORMS"] = env_had
+    assert code == 1
+    assert rec["metric"] == "error"
+    assert "device link DOWN" in rec["error"]
+    assert rec["phase"] == "main"
+    assert rec["device_link"]["state"] == "DOWN"
+    # the fake's canary never completes, so no RTT was ever measured
+    assert rec["device_link"]["last_canary_rtt_ms"] is None
+    assert any(e["kind"] == "devhealth.transition"
+               for e in rec["flightrec"]["events"])
+    assert elapsed < 30, \
+        f"DOWN link not failed fast: {elapsed:.1f}s"
+
+
 def test_child_error_record_carries_phase():
     # An error AFTER the probe marker is attributed to the main phase.
     code, rec, _ = run_bench("error")
