@@ -11,12 +11,14 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_netfault.py tests/test_join.py \
 	tests/test_golden_cluster.py tests/test_fuzz_cluster.py \
 	tests/test_shardwidth_matrix.py tests/test_tls.py \
-	tests/test_bench_orchestrator.py
+	tests/test_bench_orchestrator.py tests/test_crashmatrix.py
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
-	test-flightrec test-devhealth test-explain lint bench-cpu
+	test-flightrec test-devhealth test-explain test-durability lint \
+	bench-cpu
 
-test: test-core test-distributed test-flightrec test-devhealth test-explain
+test: test-core test-distributed test-flightrec test-devhealth \
+	test-explain test-durability
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -40,6 +42,13 @@ test-devhealth:
 # flagging + the /debug/plans ring, and cluster sub-plan aggregation.
 test-explain:
 	$(PY) -m pytest tests/test_explain.py $(PYTEST_FLAGS)
+
+# Durability surface: oplog unit tests (torn tails, checkpoints, fsync
+# policy), the fault-injection framework, and the crash-matrix — real
+# server subprocesses killed at armed fault points and restarted.
+test-durability:
+	$(PY) -m pytest tests/test_oplog.py tests/test_faultpoints.py \
+		tests/test_crashmatrix.py $(PYTEST_FLAGS)
 
 # Query observability surface: per-query profiles, histograms, the
 # slow-query log, trace retention, and the exposition formats.

@@ -72,6 +72,17 @@ def _mask(i):
     return np.uint32((i * 2654435761) & 0xFFFFFFFF)
 
 
+def _fsync_mode():
+    """Process-wide fsync policy (storage/oplog.py) tagged into every
+    emitted record."""
+    try:
+        from pilosa_tpu.storage.oplog import fsync_policy
+
+        return fsync_policy()
+    except Exception:
+        return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -247,6 +258,10 @@ def main():
         "extra": {
             "kernel_qps": round(qps, 2),
             "platform": platform,
+            # durability setting the numbers were measured under —
+            # fsync=always trades ack latency for power-loss safety, so
+            # comparisons across runs must be like-for-like
+            "fsync_mode": _fsync_mode(),
             "device_kind": getattr(device, "device_kind", ""),
             "n_shards": n_shards,
             "batch_size": batch,

@@ -102,7 +102,32 @@ def cmd_server(args):
             num_processes=len(norm),
             process_id=norm.index(local_ref))
 
+    # Durability: fault points arm from the env BEFORE any fsync/replay
+    # code runs (a crash harness must be able to hit boot-time points),
+    # and the node-wide fsync policy is set BEFORE fragments open so the
+    # very first appended op already honors it.
+    from .storage import oplog as _oplog_mod
+    from .utils import faultpoints as _faultpoints
+
+    _faultpoints.configure_from_env()
+    storage_cfg = config.get("storage", {}) if isinstance(
+        config.get("storage", {}), dict) else {}
+    _oplog_mod.set_fsync_policy(
+        storage_cfg.get("fsync", "never"),
+        interval=storage_cfg.get("fsync-interval"))
+
     holder = Holder(data_dir, max_op_n=config.get("max-op-n")).open()
+
+    oplog = None
+    if storage_cfg.get("oplog", True):
+        from .utils.logger import StandardLogger as _OplogLogger
+
+        seg_bytes = storage_cfg.get("oplog-segment-bytes")
+        oplog = _oplog_mod.OpLog(
+            os.path.join(data_dir, "oplog"),
+            segment_max_bytes=int(seg_bytes) if seg_bytes
+            else _oplog_mod.DEFAULT_SEGMENT_BYTES,
+            logger=_OplogLogger()).open()
 
     # Cluster bootstrap: static host list (the JAX-distributed model —
     # hosts known up front; reference: gossip seeds server/config.go), OR
@@ -204,7 +229,7 @@ def cmd_server(args):
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
               max_writes_per_request=int(mwpr),
-              spmd=spmd)
+              spmd=spmd, oplog=oplog)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -311,6 +336,14 @@ def cmd_server(args):
     if isinstance(origins, str):  # scalar TOML value / comma-joined flag
         origins = origins.split(",")
     origins = [o.strip() for o in origins if o.strip()]
+    # Crash recovery BEFORE serving: re-apply acked writes the previous
+    # process died holding, so the first query already sees them.
+    if oplog is not None:
+        replayed = api.replay_oplog()
+        if replayed:
+            print(f"oplog: replayed {replayed} record(s) after unclean "
+                  "shutdown", flush=True)
+
     server = PilosaHTTPServer(
         api, host=host, port=int(port or 10101), stats=stats,
         tls_cert=tls_cfg.get("certificate"),
@@ -402,6 +435,10 @@ def cmd_server(args):
             monitor.stop()
         server.stop()
         holder.close()
+        if oplog is not None:
+            # AFTER holder.close(): fragments are synced and closed, so
+            # the shutdown checkpoint can bless everything applied
+            oplog.close()
     return 0
 
 
@@ -737,6 +774,21 @@ def _apply_server_flags(config, args):
         if not isinstance(handler, dict):
             handler = config["handler"] = {}
         handler["allowed-origins"] = args.allowed_origins
+    # Durability knobs live in [storage] — ONE fsync policy shared by the
+    # write-ahead oplog and the fragment WALs (a split policy would make
+    # the documented durability level a lie at whichever layer is weaker).
+    if getattr(args, "fsync", None) is not None \
+            or getattr(args, "no_oplog", False) \
+            or getattr(args, "oplog_segment_bytes", None) is not None:
+        storage = config.get("storage")
+        if not isinstance(storage, dict):
+            storage = config["storage"] = {}
+        if getattr(args, "fsync", None) is not None:
+            storage["fsync"] = args.fsync
+        if getattr(args, "no_oplog", False):
+            storage["oplog"] = False
+        if getattr(args, "oplog_segment_bytes", None) is not None:
+            storage["oplog-segment-bytes"] = args.oplog_segment_bytes
     return config
 
 
@@ -889,6 +941,21 @@ def main(argv=None):
     p.add_argument("--device-probe-deadline", default=None,
                    help="per-canary deadline (e.g. 5s) before a probe "
                         "counts as a device-link failure (default 5s)")
+    p.add_argument("--fsync", default=None,
+                   choices=["always", "interval", "never"],
+                   help="durability fsync policy for the write-ahead "
+                        "oplog AND fragment WALs ([storage] fsync; "
+                        "default never): always = fsync before every "
+                        "ack, interval = background fsync every ~50ms, "
+                        "never = OS flush only")
+    p.add_argument("--no-oplog", action="store_true", default=False,
+                   help="disable the durable write-ahead oplog "
+                        "([storage] oplog = false): acked writes held "
+                        "only in memory are lost on crash")
+    p.add_argument("--oplog-segment-bytes", type=int, default=None,
+                   help="oplog segment rotation size in bytes "
+                        "([storage] oplog-segment-bytes; default 64MiB); "
+                        "rotation also triggers a checkpoint")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV data")
@@ -978,6 +1045,10 @@ def main(argv=None):
     p.add_argument("--explain-misestimate-factor", type=float, default=None)
     p.add_argument("--device-probe-interval", default=None)
     p.add_argument("--device-probe-deadline", default=None)
+    p.add_argument("--fsync", default=None,
+                   choices=["always", "interval", "never"])
+    p.add_argument("--no-oplog", action="store_true", default=False)
+    p.add_argument("--oplog-segment-bytes", type=int, default=None)
     p.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)

@@ -24,7 +24,7 @@ import logging
 import threading
 import uuid
 
-from ..utils import flightrec
+from ..utils import faultpoints, flightrec
 from .broadcast import MessageType, Serializer
 from .node import CLUSTER_STATE_NORMAL, CLUSTER_STATE_RESIZING, Node
 
@@ -326,6 +326,9 @@ class ResizeManager:
         source enumerates its fragments — views are data-dependent, so
         the destination cannot know them from the schema alone."""
         index, shard = src["index"], int(src["shard"])
+        # crash-test timing hook: arming a delay here holds the cluster
+        # in RESIZING long enough to queue writes deterministically
+        faultpoints.reached("resize.fetch")
         client = self.client_factory(src["sourceURI"])
         idx = self.holder.index(index)
         if idx is None:

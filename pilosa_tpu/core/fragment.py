@@ -49,6 +49,8 @@ from ..shardwidth import (
     WORDS_PER_CONTAINER,
     WORDS_PER_ROW,
 )
+from ..storage import oplog as oplog_mod
+from ..utils import faultpoints
 
 # Number of rows per merkle hash block (reference: fragment.go:80).
 HASH_BLOCK_SIZE = 100
@@ -165,10 +167,20 @@ class Fragment:
         with self._lock:
             self.flush_cache()
             if self._file:
+                if oplog_mod.fsync_policy() != "never":
+                    oplog_mod.fsync_file(self._file)
                 self._file.close()
                 self._file = None
             self._row_cache.clear()
         self._drop_mutex_vec()
+
+    def sync(self):
+        """Force the WAL tail to disk regardless of fsync policy (used by
+        the oplog checkpoint: fragments must be durable before the log
+        above them truncates)."""
+        with self._lock:
+            if self._file is not None:
+                oplog_mod.fsync_file(self._file)
 
     @property
     def is_open(self):
@@ -570,6 +582,10 @@ class Fragment:
         if self._file is not None:
             self._file.write(op_bytes)
             self._file.flush()
+            # honor the node-wide fsync policy (one knob for the oplog
+            # AND the fragment WAL — the documented durability level is
+            # only as strong as its weakest layer)
+            oplog_mod.after_append(self._file)
         self.op_n += 1
         if self.op_n > self.max_op_n:
             if self.snapshot_queue is not None:
@@ -593,8 +609,14 @@ class Fragment:
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
             f.write(serialize(self.storage, flags=self.flags))
+            if oplog_mod.fsync_policy() != "never":
+                # the rename below atomically replaces snapshot+oplog
+                # with snapshot-only; an unsynced temp would make that
+                # swap a downgrade on power loss
+                oplog_mod.fsync_file(f)
         if self._file:
             self._file.close()
+        faultpoints.reached("fragment.snapshot.rename")
         os.replace(tmp, self.path)
         self._file = open(self.path, "ab")
         self.op_n = 0

@@ -149,6 +149,59 @@ class Holder:
         for frag in self._all_fragments():
             frag.recalculate_cache()
 
+    # -- durability ----------------------------------------------------------
+
+    def sync_fragments(self):
+        """fsync every open fragment's WAL file. Called before an oplog
+        checkpoint: once the fragments below the log are durable, the
+        checkpointed prefix truly never needs replaying."""
+        n = 0
+        for frag in self._all_fragments():
+            try:
+                frag.sync()
+                n += 1
+            except Exception:
+                logging.getLogger("pilosa_tpu").exception(
+                    "fsync failed for %r", frag)
+        return n
+
+    def replay_oplog(self, oplog, apply, logger=None):
+        """Boot-time crash recovery: feed every unapplied oplog record
+        through ``apply(lsn, record)`` in LSN order. A record that fails
+        is logged and counted, not fatal — one poisoned record must not
+        keep the node from booting (same stance as torn-tail
+        truncation). Returns ``(applied, failed)``."""
+        from ..utils import flightrec
+
+        applied = failed = 0
+        first = last = None
+        for lsn, record in oplog.replay():
+            if first is None:
+                first = lsn
+            last = lsn
+            try:
+                apply(lsn, record)
+                applied += 1
+            except Exception as e:  # noqa: BLE001 — count, don't wedge boot
+                failed += 1
+                if logger is not None:
+                    logger.printf(
+                        "oplog replay: record lsn=%d (%s) failed: %s",
+                        lsn, record.get("kind"), e)
+            finally:
+                # failed records advance the watermark too: they are
+                # deterministic failures, not transient ones, and must
+                # not pin the checkpoint (they were counted above)
+                oplog.mark_applied(lsn)
+        if applied or failed:
+            flightrec.record("oplog.replay", first_lsn=first, last_lsn=last,
+                             applied=applied, failed=failed)
+            if logger is not None:
+                logger.printf(
+                    "oplog replay: %d applied, %d failed (lsn %s..%s)",
+                    applied, failed, first, last)
+        return applied, failed
+
     # -- indexes ------------------------------------------------------------
 
     def _new_index(self, name):

@@ -6,6 +6,8 @@ holder/executor. Validation of cluster-state-permitted methods
 attached; single-node mode permits everything.
 """
 
+import array
+import base64
 import io
 import csv
 import threading
@@ -14,6 +16,7 @@ import time
 import numpy as np
 
 from ..cluster.broadcast import MessageType, Serializer
+from ..utils import faultpoints
 from ..core import FieldOptions, Holder, IndexOptions
 from ..core.field import (
     FIELD_TYPE_BOOL,
@@ -54,6 +57,39 @@ class ServiceUnavailableError(ApiError):
         if retry_after is not None:
             self.headers = {
                 "Retry-After": str(max(1, int(round(retry_after))))}
+
+
+#: oplog binary-list type codes -> array.array typecodes ('I' is only
+#: u4 where the platform says so; the log is node-local, so the machine
+#: that wrote a record is the machine that replays it)
+_OPLOG_DT = {"u4": "I", "u8": "Q", "i8": "q"}
+_U4_OK = array.array("I").itemsize == 4
+
+
+def _oplog_pack_ints(v):
+    """base64-of-packed-ints record field for an id/value list, or None
+    when ``v`` isn't an int list (keys, mixed). Tries u4 first — the
+    common case for row ids and per-shard column ids — then i8;
+    ndarrays pack through numpy without a Python-object round trip."""
+    if isinstance(v, np.ndarray):
+        if v.dtype.kind == "u":
+            b = np.ascontiguousarray(v, dtype="<u8").tobytes()
+            return {"__b": base64.b64encode(b).decode(), "dt": "u8"}
+        if v.dtype.kind == "i":
+            b = np.ascontiguousarray(v, dtype="<i8").tobytes()
+            return {"__b": base64.b64encode(b).decode(), "dt": "i8"}
+        return None
+    if _U4_OK:
+        try:
+            b = array.array("I", v).tobytes()
+            return {"__b": base64.b64encode(b).decode(), "dt": "u4"}
+        except (OverflowError, TypeError, ValueError):
+            pass
+    try:
+        b = array.array("q", v).tobytes()
+        return {"__b": base64.b64encode(b).decode(), "dt": "i8"}
+    except (OverflowError, TypeError, ValueError):
+        return None
 
 
 def field_options_from_json(opts):
@@ -124,12 +160,25 @@ def result_to_json(result):
 class API:
     def __init__(self, holder, cluster=None, client_factory=None,
                  long_query_time=None, logger=None, spmd=None,
-                 max_writes_per_request=0):
+                 max_writes_per_request=0, oplog=None):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
         self.holder = holder
         self.cluster = cluster
+        # Durable write-ahead oplog (storage/oplog.py): when set, every
+        # import appends its record BEFORE any ack path can return, and
+        # replay_oplog() re-applies unapplied records at boot. None (the
+        # default, and what in-process test harnesses use) keeps the
+        # pre-oplog behavior exactly.
+        self.oplog = oplog
+        # replay_lsn: the original record's LSN while a boot replay is
+        # re-running an import through the normal path (no re-append —
+        # the record already stands; apply-marking reuses its LSN)
+        self._oplog_tls = threading.local()
+        self._oplog_ckpt_lock = threading.Lock()
+        if oplog is not None:
+            oplog.on_rotate = self._oplog_rotate_checkpoint
         # SPMD data plane (cluster/spmd.py): when set, coverable Count
         # merges ride collectives instead of the HTTP data plane.
         self.spmd = spmd
@@ -195,7 +244,12 @@ class API:
     # in resize_replay_dropped).
     RESIZE_REPLAY_RETRIES = 3
 
-    def _queue_resize_write(self, kind, kwargs):
+    #: Retry-After on a full resize queue: one drain pass over a full
+    #: backlog comfortably finishes within this; a still-running resize
+    #: answers the retry with another (cheap) queue append.
+    RESIZE_QUEUE_RETRY_AFTER = 5
+
+    def _queue_resize_write(self, kind, kwargs, lsn=None):
         """True = the write was queued for post-resize replay (caller
         returns immediately); False = cluster not resizing, proceed.
 
@@ -205,7 +259,12 @@ class API:
         NORMAL here and the write proceeds normally. While a drain is
         replaying, new writes keep queueing behind it so replay order is
         arrival order (a stale queued value must not clobber a newer
-        acknowledged one)."""
+        acknowledged one).
+
+        ``lsn``: the write's oplog record (already durable — the append
+        happens before the queue check). The drain marks it applied once
+        the queued write lands, so a crash mid-drain replays the rest of
+        the backlog from the log at next boot instead of dropping it."""
         if self.cluster is None:
             return False
         if getattr(self._resize_replay_tls, "active", False):
@@ -222,9 +281,18 @@ class API:
                     and not self._resize_draining:
                 return False
             if len(self._resize_writes) >= self.RESIZE_QUEUE_MAX:
-                raise ApiError("cluster is resizing; try again later "
-                               "(write queue full)")
-            self._resize_writes.append((kind, kwargs))
+                # 503 + Retry-After, not a generic client error: a full
+                # queue is backpressure, and well-behaved clients (our
+                # server/client.py included) back off and retry instead
+                # of treating it as a server bug. The rejected write's
+                # record is marked applied — a 503 promises nothing, and
+                # an eternally-unapplied lsn would pin the checkpoint.
+                self._oplog_applied(lsn)
+                raise ServiceUnavailableError(
+                    "cluster is resizing; try again later "
+                    "(write queue full)",
+                    retry_after=self.RESIZE_QUEUE_RETRY_AFTER)
+            self._resize_writes.append((kind, kwargs, lsn))
         return True
 
     def _drain_resize_writes(self):
@@ -243,7 +311,7 @@ class API:
         from ..utils import flightrec
         from ..utils.stats import global_stats
 
-        def replay_one(kind, kwargs):
+        def replay_one(kind, kwargs, lsn):
             """Apply one queued write with bounded in-place retries.
             Retrying IN PLACE (not re-queueing at the tail) is load-
             bearing: replay order is arrival order, and a failed write
@@ -251,13 +319,22 @@ class API:
             newer acknowledged value. Only after the retries are
             exhausted is the write dropped — that is the documented
             crash-semantics loss, counted in resize_replay_dropped, not
-            a silent one."""
+            a silent one.
+
+            Durability: the queued write's oplog record (``lsn``) is
+            marked applied only here — on success AND on a counted drop
+            (else the checkpoint watermark pins forever on a record no
+            one will ever apply). A crash BEFORE this line leaves the
+            record below the watermark, so boot replay resumes the
+            backlog instead of dropping it."""
             for attempt in range(self.RESIZE_REPLAY_RETRIES):
                 try:
+                    faultpoints.reached("resize.drain.apply")
                     if kind == "bits":
                         self.import_bits(**kwargs)
                     else:
                         self.import_values(**kwargs)
+                    self._oplog_applied(lsn)
                     return
                 except Exception:
                     where = {k: kwargs[k] for k in
@@ -279,6 +356,7 @@ class API:
                             "resize write replay DROPPED after %d "
                             "attempts: %s %r", self.RESIZE_REPLAY_RETRIES,
                             kind, where)
+                        self._oplog_applied(lsn)  # counted loss, not a wedge
 
         def replay():
             self._resize_replay_tls.active = True
@@ -289,11 +367,151 @@ class API:
                     if not queued:
                         self._resize_draining = False
                         return
-                for kind, kwargs in queued:
-                    replay_one(kind, kwargs)
+                for kind, kwargs, lsn in queued:
+                    replay_one(kind, kwargs, lsn)
 
         threading.Thread(target=replay, daemon=True,
                          name="resize-write-drain").start()
+
+    # -- durable oplog (storage/oplog.py) ------------------------------------
+
+    def _oplog_append(self, kind, kwargs):
+        """Append one import's record BEFORE any queue/apply/ack step;
+        returns its LSN (None when no oplog is attached). A boot replay
+        re-entering the import path reuses the original record's LSN
+        instead of re-appending; the resize drain's own replay likewise
+        appends nothing — its queued records already stand in the log."""
+        if self.oplog is None:
+            return None
+        replay_lsn = getattr(self._oplog_tls, "replay_lsn", None)
+        if replay_lsn is not None:
+            return replay_lsn
+        if getattr(self._resize_replay_tls, "active", False):
+            return None
+        return self.oplog.append(self._oplog_encode(kind, kwargs))
+
+    def _oplog_applied(self, lsn):
+        """The write at ``lsn`` finished its synchronous apply (or was
+        counted as dropped): advance the applied watermark."""
+        if lsn is not None and self.oplog is not None:
+            self.oplog.mark_applied(lsn)
+
+    @staticmethod
+    def _oplog_encode(kind, kwargs):
+        """JSON-safe record for one import call, captured PRE-translation
+        (keys replay through the durable translate stores and get the
+        same ids) with datetimes as wire strings and roaring blobs as
+        base64. Numeric id/value lists ride as base64 of packed
+        fixed-width ints (:func:`_oplog_pack_ints`) — this sits on the
+        ack path, and at import batch sizes that serializes ~2x faster
+        and smaller than a JSON int list of the same data."""
+        rec = {"kind": kind}
+        for k, v in kwargs.items():
+            if v is None or isinstance(v, (bool, int, float, str)):
+                rec[k] = v
+            elif k == "timestamps":
+                from ..core.timeq import TIME_FORMAT
+
+                rec[k] = [t if (t is None or isinstance(t, str))
+                          else t.strftime(TIME_FORMAT) for t in v]
+            elif k == "data":
+                rec[k] = base64.b64encode(bytes(v)).decode()
+            else:
+                packed = _oplog_pack_ints(v)
+                if packed is None:  # key lists (strings), mixed lists
+                    rec[k] = np.asarray(v).tolist()
+                else:
+                    rec[k] = packed
+        return rec
+
+    @staticmethod
+    def _oplog_decode_kwargs(record):
+        """Invert :meth:`_oplog_encode`'s binary list packing (replay
+        path only — cold)."""
+        kw = {}
+        for k, v in record.items():
+            if k == "kind":
+                continue
+            if isinstance(v, dict) and "__b" in v:
+                arr = array.array(_OPLOG_DT[v.get("dt", "i8")])
+                arr.frombytes(base64.b64decode(v["__b"]))
+                v = arr.tolist()
+            kw[k] = v
+        return kw
+
+    def _apply_oplog_record(self, record):
+        """Replay one decoded record through the NORMAL import path (so
+        routing, key translation, existence tracking, and — if the
+        cluster is mid-resize at boot — re-queueing all behave exactly
+        like the original call did)."""
+        kind = record.get("kind")
+        kw = self._oplog_decode_kwargs(record)
+        if kind == "bits":
+            ts = kw.get("timestamps")
+            if ts is not None:
+                from ..core import timeq
+
+                kw["timestamps"] = [
+                    timeq.parse_time(t) if t else None for t in ts]
+            return self.import_bits(**kw)
+        if kind == "values":
+            return self.import_values(**kw)
+        if kind == "roaring":
+            kw["data"] = base64.b64decode(kw["data"])
+            return self.import_roaring(**kw)
+        raise ApiError(f"unknown oplog record kind: {kind!r}")
+
+    def replay_oplog(self):
+        """Boot-time crash recovery: re-apply every record past the last
+        checkpoint, in LSN (== arrival) order. Idempotent — set-bit
+        records re-set already-set bits, BSI value records replay
+        last-write-wins — so records that were applied (even fsynced)
+        before the crash converge to the pre-crash state. Returns the
+        number of records applied. Call AFTER the cluster layer is
+        attached and BEFORE serving."""
+        if self.oplog is None:
+            return 0
+
+        def apply(lsn, record):
+            self._oplog_tls.replay_lsn = lsn
+            try:
+                self._apply_oplog_record(record)
+            finally:
+                self._oplog_tls.replay_lsn = None
+
+        applied, failed = self.holder.replay_oplog(
+            self.oplog, apply, logger=self.logger)
+        if applied:
+            # everything replayed is in fragment WALs now; make it
+            # durable and move the checkpoint so the NEXT restart
+            # replays only what this boot couldn't finish
+            self.holder.sync_fragments()
+            self.oplog.checkpoint()
+        return applied
+
+    def _oplog_rotate_checkpoint(self, _sealed_last_lsn):
+        """Segment rotation is the checkpoint trigger that keeps the log
+        bounded: fsync every fragment (making all applied records
+        durable BELOW the log) then checkpoint at the applied watermark,
+        dropping fully-applied sealed segments. Runs on its own thread —
+        the append that tripped the rotation must not wait out a full
+        fragment fsync sweep — and the non-blocking lock collapses
+        back-to-back rotations into one sweep."""
+        if not self._oplog_ckpt_lock.acquire(blocking=False):
+            return
+
+        def run():
+            try:
+                self.holder.sync_fragments()
+                self.oplog.checkpoint()
+            except Exception as e:  # noqa: BLE001 — retried at next rotate
+                self.logger.printf(
+                    "oplog checkpoint after rotation failed: %s", e)
+            finally:
+                self._oplog_ckpt_lock.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name="oplog-checkpoint").start()
 
     def query(self, index_name, pql, shards=None, options=None):
         """(reference: api.Query api.go:135)"""
@@ -753,69 +971,81 @@ class API:
         while RESIZING, so the check stays valid at replay) — a doomed
         import must 404 now, not vanish into a replay-time log line."""
         field = self._field(index_name, field_name)
-        if self._queue_resize_write(
-                "bits", dict(index_name=index_name, field_name=field_name,
-                             row_ids=row_ids, column_ids=column_ids,
-                             timestamps=timestamps, clear=clear,
-                             remote=remote, row_keys=row_keys,
-                             column_keys=column_keys)):
+        kwargs = dict(index_name=index_name, field_name=field_name,
+                      row_ids=row_ids, column_ids=column_ids,
+                      timestamps=timestamps, clear=clear,
+                      remote=remote, row_keys=row_keys,
+                      column_keys=column_keys)
+        lsn = self._oplog_append("bits", kwargs)
+        faultpoints.reached("import.post-append")
+        if self._queue_resize_write("bits", kwargs, lsn=lsn):
             return 0
-        if row_keys is not None or column_keys is not None:
-            t_rows, t_cols = self._translate_import_keys(
-                index_name, field_name, row_keys, column_keys)
-            if t_rows is not None:
-                row_ids = t_rows
-            if t_cols is not None:
-                column_ids = t_cols
-        if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
-            changed = field.import_bits(
-                row_ids, column_ids, timestamps=timestamps, clear=clear)
-            self.holder.index(index_name).add_existence(column_ids)
+        try:
+            if row_keys is not None or column_keys is not None:
+                t_rows, t_cols = self._translate_import_keys(
+                    index_name, field_name, row_keys, column_keys)
+                if t_rows is not None:
+                    row_ids = t_rows
+                if t_cols is not None:
+                    column_ids = t_cols
+            if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
+                changed = field.import_bits(
+                    row_ids, column_ids, timestamps=timestamps, clear=clear)
+                self.holder.index(index_name).add_existence(column_ids)
+                self._broadcast_shards_if_changed(index_name)
+                faultpoints.reached("import.pre-ack")
+                return changed
+
+            import numpy as np
+
+            from ..core.timeq import TIME_FORMAT
+
+            row_ids = np.asarray(row_ids, dtype=np.uint64)
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            shards = column_ids // np.uint64(SHARD_WIDTH)
+            changed = 0
+            jobs, covered, remote_only = [], set(), set()
+            for shard in np.unique(shards):
+                shard = int(shard)
+                mask = shards == shard
+                local, remotes = self._route_import(index_name, shard)
+                slice_rows = row_ids[mask]
+                slice_cols = column_ids[mask]
+                slice_ts = None
+                if timestamps is not None:
+                    ts_arr = np.asarray(timestamps, dtype=object)
+                    slice_ts = ts_arr[mask].tolist()
+                if local:
+                    changed += field.import_bits(
+                        slice_rows, slice_cols, timestamps=slice_ts,
+                        clear=clear)
+                    self.holder.index(index_name).add_existence(slice_cols)
+                    covered.add(shard)
+                else:
+                    remote_only.add(shard)
+                wire_ts = None
+                if slice_ts is not None:
+                    wire_ts = [
+                        t.strftime(TIME_FORMAT) if t is not None else None
+                        for t in slice_ts]
+                for node in remotes:
+                    jobs.append((shard, node, (
+                        lambda n=node, r=slice_rows, c=slice_cols, w=wire_ts:
+                        self.client_factory(n.uri).import_bits(
+                            index_name, field_name, r.tolist(), c.tolist(),
+                            timestamps=w, clear=clear, remote=True))))
+            _, remote_changed = self._fan_out_writes(
+                jobs, covered, count_shards=remote_only,
+                index_name=index_name)
             self._broadcast_shards_if_changed(index_name)
-            return changed
-
-        import numpy as np
-
-        from ..core.timeq import TIME_FORMAT
-
-        row_ids = np.asarray(row_ids, dtype=np.uint64)
-        column_ids = np.asarray(column_ids, dtype=np.uint64)
-        shards = column_ids // np.uint64(SHARD_WIDTH)
-        changed = 0
-        jobs, covered, remote_only = [], set(), set()
-        for shard in np.unique(shards):
-            shard = int(shard)
-            mask = shards == shard
-            local, remotes = self._route_import(index_name, shard)
-            slice_rows = row_ids[mask]
-            slice_cols = column_ids[mask]
-            slice_ts = None
-            if timestamps is not None:
-                ts_arr = np.asarray(timestamps, dtype=object)
-                slice_ts = ts_arr[mask].tolist()
-            if local:
-                changed += field.import_bits(
-                    slice_rows, slice_cols, timestamps=slice_ts, clear=clear)
-                self.holder.index(index_name).add_existence(slice_cols)
-                covered.add(shard)
-            else:
-                remote_only.add(shard)
-            wire_ts = None
-            if slice_ts is not None:
-                wire_ts = [
-                    t.strftime(TIME_FORMAT) if t is not None else None
-                    for t in slice_ts]
-            for node in remotes:
-                jobs.append((shard, node, (
-                    lambda n=node, r=slice_rows, c=slice_cols, w=wire_ts:
-                    self.client_factory(n.uri).import_bits(
-                        index_name, field_name, r.tolist(), c.tolist(),
-                        timestamps=w, clear=clear, remote=True))))
-        _, remote_changed = self._fan_out_writes(
-            jobs, covered, count_shards=remote_only,
-            index_name=index_name)
-        self._broadcast_shards_if_changed(index_name)
-        return changed + remote_changed
+            faultpoints.reached("import.pre-ack")
+            return changed + remote_changed
+        finally:
+            # an exception here means NO ack went out, so the record
+            # needs no replay guarantee — mark it applied either way so
+            # one failed import can't pin the checkpoint watermark
+            # forever (a process crash skips this; that's the point)
+            self._oplog_applied(lsn)
 
     def import_values(self, index_name, field_name, column_ids, values,
                       remote=False, column_keys=None, clear=False):
@@ -823,53 +1053,60 @@ class API:
         ImportValue with OptImportOptionsClear api.go:1035 ->
         field.importValue field.go:1285)."""
         field = self._field(index_name, field_name)
-        if self._queue_resize_write(
-                "values", dict(index_name=index_name, field_name=field_name,
-                               column_ids=column_ids, values=values,
-                               remote=remote, column_keys=column_keys,
-                               clear=clear)):
+        kwargs = dict(index_name=index_name, field_name=field_name,
+                      column_ids=column_ids, values=values,
+                      remote=remote, column_keys=column_keys,
+                      clear=clear)
+        lsn = self._oplog_append("values", kwargs)
+        faultpoints.reached("import.post-append")
+        if self._queue_resize_write("values", kwargs, lsn=lsn):
             return 0
-        if column_keys is not None:
-            _, column_ids = self._translate_import_keys(
-                index_name, field_name, None, column_keys)
-        if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
-            changed = field.import_values(column_ids, values, clear=clear)
-            if not clear:
-                self.holder.index(index_name).add_existence(column_ids)
-            self._broadcast_shards_if_changed(index_name)
-            return changed
-
-        import numpy as np
-
-        column_ids = np.asarray(column_ids, dtype=np.uint64)
-        values = np.asarray(values, dtype=np.int64)
-        shards = column_ids // np.uint64(SHARD_WIDTH)
-        changed = 0
-        jobs, covered, remote_only = [], set(), set()
-        for shard in np.unique(shards):
-            shard = int(shard)
-            mask = shards == shard
-            local, remotes = self._route_import(index_name, shard)
-            if local:
-                changed += field.import_values(
-                    column_ids[mask], values[mask], clear=clear)
+        try:
+            if column_keys is not None:
+                _, column_ids = self._translate_import_keys(
+                    index_name, field_name, None, column_keys)
+            if remote or self.cluster is None or len(self.cluster.nodes) <= 1:
+                changed = field.import_values(column_ids, values, clear=clear)
                 if not clear:
-                    self.holder.index(index_name).add_existence(
-                        column_ids[mask])
-                covered.add(shard)
-            else:
-                remote_only.add(shard)
-            for node in remotes:
-                jobs.append((shard, node, (
-                    lambda n=node, c=column_ids[mask], v=values[mask]:
-                    self.client_factory(n.uri).import_values(
-                        index_name, field_name, c.tolist(), v.tolist(),
-                        remote=True, clear=clear))))
-        _, remote_changed = self._fan_out_writes(
-            jobs, covered, count_shards=remote_only,
-            index_name=index_name)
-        self._broadcast_shards_if_changed(index_name)
-        return changed + remote_changed
+                    self.holder.index(index_name).add_existence(column_ids)
+                self._broadcast_shards_if_changed(index_name)
+                faultpoints.reached("import.pre-ack")
+                return changed
+
+            import numpy as np
+
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            values = np.asarray(values, dtype=np.int64)
+            shards = column_ids // np.uint64(SHARD_WIDTH)
+            changed = 0
+            jobs, covered, remote_only = [], set(), set()
+            for shard in np.unique(shards):
+                shard = int(shard)
+                mask = shards == shard
+                local, remotes = self._route_import(index_name, shard)
+                if local:
+                    changed += field.import_values(
+                        column_ids[mask], values[mask], clear=clear)
+                    if not clear:
+                        self.holder.index(index_name).add_existence(
+                            column_ids[mask])
+                    covered.add(shard)
+                else:
+                    remote_only.add(shard)
+                for node in remotes:
+                    jobs.append((shard, node, (
+                        lambda n=node, c=column_ids[mask], v=values[mask]:
+                        self.client_factory(n.uri).import_values(
+                            index_name, field_name, c.tolist(), v.tolist(),
+                            remote=True, clear=clear))))
+            _, remote_changed = self._fan_out_writes(
+                jobs, covered, count_shards=remote_only,
+                index_name=index_name)
+            self._broadcast_shards_if_changed(index_name)
+            faultpoints.reached("import.pre-ack")
+            return changed + remote_changed
+        finally:
+            self._oplog_applied(lsn)
 
     def import_roaring(self, index_name, field_name, shard, data,
                        clear=False, view="standard", remote=False):
@@ -878,23 +1115,31 @@ class API:
         self._validate_state()
         field = self._field(index_name, field_name)
         shard = int(shard)
-        local, remotes = (True, []) if remote else \
-            self._route_import(index_name, shard)
-        changed = 0
-        if local:
-            v = field.create_view_if_not_exists(view)
-            frag = v.create_fragment_if_not_exists(shard)
-            changed = frag.import_roaring(data, clear=clear)
-        jobs = [(shard, node, (
-            lambda n=node: self.client_factory(n.uri).import_roaring(
-                index_name, field_name, shard, data, clear=clear, view=view,
-                remote=True))) for node in remotes]
-        _, remote_changed = self._fan_out_writes(
-            jobs, {shard} if local else set(),
-            count_shards=() if local else {shard},
-            index_name=index_name)
-        self._broadcast_shards_if_changed(index_name)
-        return changed if local else remote_changed
+        lsn = self._oplog_append("roaring", dict(
+            index_name=index_name, field_name=field_name, shard=shard,
+            data=data, clear=clear, view=view, remote=remote))
+        faultpoints.reached("import.post-append")
+        try:
+            local, remotes = (True, []) if remote else \
+                self._route_import(index_name, shard)
+            changed = 0
+            if local:
+                v = field.create_view_if_not_exists(view)
+                frag = v.create_fragment_if_not_exists(shard)
+                changed = frag.import_roaring(data, clear=clear)
+            jobs = [(shard, node, (
+                lambda n=node: self.client_factory(n.uri).import_roaring(
+                    index_name, field_name, shard, data, clear=clear,
+                    view=view, remote=True))) for node in remotes]
+            _, remote_changed = self._fan_out_writes(
+                jobs, {shard} if local else set(),
+                count_shards=() if local else {shard},
+                index_name=index_name)
+            self._broadcast_shards_if_changed(index_name)
+            faultpoints.reached("import.pre-ack")
+            return changed if local else remote_changed
+        finally:
+            self._oplog_applied(lsn)
 
     def _field(self, index_name, field_name):
         idx = self.holder.index(index_name)
@@ -1007,7 +1252,7 @@ class API:
             return None
         hbm = local.hbm_stats(top=0)
         kernels = local.kernel_stats(include_costs=False)["kernels"]
-        return {
+        out = {
             "hbm": {k: hbm[k] for k in (
                 "total_bytes", "stack_bytes", "stack_entries",
                 "rows_stack_bytes", "rows_stack_entries")},
@@ -1018,6 +1263,9 @@ class API:
             "plans": plan_mod.stats(),
             "device_link": devhealth.summary(),
         }
+        if self.oplog is not None:
+            out["oplog"] = self.oplog.summary(compact=True)
+        return out
 
     #: peer observability fetches must never wedge a /status response
     #: behind a dead node (client default is 30s)
@@ -1051,6 +1299,12 @@ class API:
                                   ("state", "state_since",
                                    "consecutive_failures", "probes",
                                    "last")}
+            op = client.debug_oplog()
+            if op.get("enabled"):
+                out["oplog"] = {k: op.get(k) for k in
+                                ("fsync", "last_lsn", "checkpoint_lsn",
+                                 "replay_lag", "unapplied", "segments",
+                                 "truncated_tails")}
             return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}

@@ -1,9 +1,19 @@
 """HTTP client (reference: http/client.go InternalClient).
 
 Used by applications, the CLI import/export commands, and node-to-node
-data-plane RPC in the cluster layer. stdlib urllib; no external deps."""
+data-plane RPC in the cluster layer. stdlib urllib; no external deps.
+
+Resilience: every request takes an optional per-request deadline, and
+idempotent requests (GETs, DELETEs, and the import paths — set-bit and
+roaring imports re-apply cleanly, BSI values are last-write-wins) retry
+transient failures with bounded, jittered exponential backoff. A 503
+with ``Retry-After`` (readiness gating, resize-queue overflow) is always
+retryable — the server has explicitly promised the request will work
+later — and the advertised delay is honored up to the backoff cap."""
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 
@@ -14,13 +24,32 @@ class ClientError(Exception):
         self.status = status
 
 
+class DeadlineExceeded(ClientError):
+    """The per-request deadline expired before a successful response
+    (status 0: the failure is client-side, no HTTP status exists)."""
+
+    def __init__(self, message):
+        super().__init__(0, message)
+
+
 class Client:
     def __init__(self, base_url, timeout=30, tls_skip_verify=False,
-                 ca_cert=None):
+                 ca_cert=None, retries=2, backoff=0.1, backoff_max=2.0,
+                 deadline=None):
         """tls_skip_verify / ca_cert: https trust options (reference:
-        tls.skip-verify / tls.ca-certificate server config)."""
+        tls.skip-verify / tls.ca-certificate server config).
+
+        retries: extra attempts for retryable failures (0 disables);
+        backoff/backoff_max: jittered exponential backoff bounds, also
+        the cap on an honored ``Retry-After``; deadline: default
+        per-request wall-clock budget in seconds across ALL attempts
+        (None = no deadline; per-attempt socket timeout still applies)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self.backoff_max = backoff_max
+        self.deadline = deadline
         self._ssl_context = None
         if base_url.startswith("https"):
             import ssl
@@ -34,9 +63,58 @@ class Client:
                 self._ssl_context = ssl.create_default_context(
                     cafile=ca_cert)
 
-    def _request(self, method, path, body=None, content_type="application/json"):
+    def _request(self, method, path, body=None,
+                 content_type="application/json", idempotent=None,
+                 deadline=None):
+        """idempotent: may network-level failures be retried? (an HTTP
+        503 is retried regardless — the server rejected the request
+        before doing work). Defaults to True for GET/DELETE."""
+        if idempotent is None:
+            idempotent = method in ("GET", "DELETE")
+        if deadline is None:
+            deadline = self.deadline
+        deadline_at = None if deadline is None else \
+            time.monotonic() + deadline
+        attempt = 0
+        while True:
+            retry_after = None
+            try:
+                return self._request_once(method, path, body, content_type,
+                                          deadline_at)
+            except ClientError as e:
+                if e.status != 503 or attempt >= self.retries:
+                    raise
+                retry_after = getattr(e, "retry_after", None)
+            except (urllib.error.URLError, TimeoutError, OSError):
+                # includes socket.timeout and connection refused/reset;
+                # non-idempotent requests may have partially executed
+                if not idempotent or attempt >= self.retries:
+                    raise
+            delay = min(self.backoff_max,
+                        self.backoff * (2 ** attempt))
+            delay *= random.uniform(0.5, 1.0)  # jitter: decorrelate peers
+            if retry_after is not None:
+                # the server knows better than our backoff curve, but
+                # never wait longer than the configured cap
+                delay = min(max(delay, retry_after), self.backoff_max)
+            if deadline_at is not None and \
+                    time.monotonic() + delay >= deadline_at:
+                raise DeadlineExceeded(
+                    f"deadline exceeded after {attempt + 1} attempt(s): "
+                    f"{method} {path}")
+            time.sleep(delay)
+            attempt += 1
+
+    def _request_once(self, method, path, body, content_type, deadline_at):
         from ..utils import tracing
 
+        timeout = self.timeout
+        if deadline_at is not None:
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"deadline exceeded: {method} {path}")
+            timeout = min(timeout, remaining)
         req = urllib.request.Request(
             self.base_url + path, data=body, method=method)
         if body is not None:
@@ -45,7 +123,7 @@ class Client:
             req.add_header(k, v)  # cross-node trace context (client inject)
         try:
             with urllib.request.urlopen(
-                    req, timeout=self.timeout,
+                    req, timeout=timeout,
                     context=self._ssl_context) as resp:
                 data = resp.read()
                 ctype = resp.headers.get("Content-Type", "")
@@ -54,7 +132,14 @@ class Client:
                 message = json.loads(e.read().decode()).get("error", str(e))
             except Exception:
                 message = str(e)
-            raise ClientError(e.code, message) from e
+            err = ClientError(e.code, message)
+            ra = e.headers.get("Retry-After") if e.headers else None
+            if ra is not None:
+                try:
+                    err.retry_after = float(ra)
+                except ValueError:
+                    pass
+            raise err from e
         if ctype.startswith("application/json"):
             return json.loads(data.decode()) if data else None
         return data
@@ -130,7 +215,9 @@ class Client:
 
     def import_bits(self, index, field, row_ids, column_ids,
                     timestamps=None, clear=False, remote=False,
-                    row_keys=None, column_keys=None):
+                    row_keys=None, column_keys=None, deadline=None):
+        """idempotent=True: re-setting a set bit is a no-op, so a retry
+        after an ambiguous network failure cannot corrupt anything."""
         path = f"/index/{index}/field/{field}/import"
         params = []
         if clear:
@@ -150,10 +237,13 @@ class Client:
             body["columnIDs"] = [int(c) for c in column_ids]
         if timestamps is not None:
             body["timestamps"] = timestamps
-        return self._request("POST", path, json.dumps(body).encode())
+        return self._request("POST", path, json.dumps(body).encode(),
+                             idempotent=True, deadline=deadline)
 
     def import_values(self, index, field, column_ids, values, remote=False,
-                      column_keys=None, clear=False):
+                      column_keys=None, clear=False, deadline=None):
+        """idempotent=True: replaying the same value assignment is
+        last-write-wins over itself."""
         path = f"/index/{index}/field/{field}/import"
         params = [p for p, on in (("remote=true", remote),
                                   ("clear=true", clear)) if on]
@@ -164,10 +254,11 @@ class Client:
             body["columnKeys"] = list(column_keys)
         else:
             body["columnIDs"] = [int(c) for c in column_ids]
-        return self._request("POST", path, json.dumps(body).encode())
+        return self._request("POST", path, json.dumps(body).encode(),
+                             idempotent=True, deadline=deadline)
 
     def import_roaring(self, index, field, shard, data, clear=False,
-                       view="standard", remote=False):
+                       view="standard", remote=False, deadline=None):
         path = (f"/index/{index}/field/{field}/import-roaring/{shard}"
                 f"?view={view}")
         if clear:
@@ -175,7 +266,8 @@ class Client:
         if remote:
             path += "&remote=true"
         return self._request(
-            "POST", path, data, content_type="application/octet-stream")
+            "POST", path, data, content_type="application/octet-stream",
+            idempotent=True, deadline=deadline)
 
     # -- misc ----------------------------------------------------------------
 
@@ -217,6 +309,11 @@ class Client:
     def debug_dispatch(self):
         """The peer's per-kernel dispatch-phase RTT decomposition."""
         return self._request("GET", "/debug/dispatch")
+
+    def debug_oplog(self):
+        """The peer's durable-oplog summary (segments, checkpoint,
+        replay lag); {"enabled": False} when the node runs without one."""
+        return self._request("GET", "/debug/oplog")
 
     def debug_flightrecorder(self, limit=None):
         """The peer's flight-recorder tail."""

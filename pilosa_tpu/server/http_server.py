@@ -159,6 +159,9 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/device", self._get_debug_device,
                   args=("limit",)),
             Route("GET", r"/debug/dispatch", self._get_debug_dispatch),
+            Route("GET", r"/debug/oplog", self._get_debug_oplog),
+            Route("GET", r"/debug/faultpoints", self._get_faultpoints),
+            Route("POST", r"/debug/faultpoints", self._post_faultpoints),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
             Route("POST", r"/debug/pprof/profile/start",
                   self._profile_start),
@@ -676,6 +679,45 @@ class PilosaHTTPServer:
         if not hasattr(local, "dispatch_phase_stats"):
             raise NotFoundError("no stacked evaluator on this node")
         return local.dispatch_phase_stats()
+
+    def _get_debug_oplog(self, req):
+        """Durable-oplog summary: segments, checkpoint, replay lag."""
+        oplog = getattr(self.api, "oplog", None)
+        if oplog is None:
+            return {"enabled": False,
+                    "hint": "node started without a write-ahead oplog "
+                            "(storage oplog=false or no data dir)"}
+        out = oplog.summary()
+        out["enabled"] = True
+        return out
+
+    def _get_faultpoints(self, req):
+        """Armed fault points + hit counters (crash-test introspection)."""
+        from ..utils import faultpoints
+
+        return faultpoints.snapshot()
+
+    def _post_faultpoints(self, req):
+        """Arm/disarm fault points on a live server. Body:
+        ``{"arm": "<spec>" | ["<spec>", ...], "disarm": "<name>"|"all"}``
+        (spec grammar in utils/faultpoints.py). Test-only surface — like
+        /debug/pprof it mutates process behavior, so it is part of the
+        debug namespace, not the public API."""
+        from ..utils import faultpoints
+
+        body = json.loads(req.body.decode() or "{}")
+        disarm = body.get("disarm")
+        if disarm is not None:
+            faultpoints.disarm(None if disarm == "all" else disarm)
+        arm = body.get("arm")
+        if arm is not None:
+            specs = arm if isinstance(arm, list) else [arm]
+            try:
+                for spec in specs:
+                    faultpoints.arm(spec)
+            except ValueError as e:
+                raise ApiError(str(e)) from e
+        return faultpoints.snapshot()
 
     # -- profiling (reference: /debug/pprof routes http/handler.go:280;
     #    profile.cpu config server/config.go) --------------------------------

@@ -8,6 +8,7 @@ BoltDB as the embedded KV engine; the interfaces mirror the reference's
 """
 
 from .attrs import AttrStore, SqliteAttrStore, MemAttrStore
+from .oplog import OpLog, OpLogError, fsync_policy, set_fsync_policy
 from .translate import (
     TranslateStore,
     SqliteTranslateStore,
@@ -17,6 +18,10 @@ from .translate import (
 )
 
 __all__ = [
+    "OpLog",
+    "OpLogError",
+    "fsync_policy",
+    "set_fsync_policy",
     "AttrStore",
     "SqliteAttrStore",
     "MemAttrStore",

@@ -1,0 +1,313 @@
+"""Crash-matrix: kill a real server at armed fault points under load,
+restart it on the same data dir, and prove no acknowledged write is lost.
+
+Each round arms one fault point over HTTP (POST /debug/faultpoints) on a
+live ``pilosa_tpu server`` subprocess running with ``--fsync always``,
+drives imports until the armed ``exit`` action kills the process with
+``os._exit(86)`` (no atexit, no finally — a hard crash), then restarts
+the server and asserts every acknowledged column is readable. The rounds
+chain on ONE data dir, so each boot also exercises oplog replay of the
+previous round's unapplied tail.
+
+Matrix (fault point -> crash window):
+  import.post-append       appended, not applied, not acked
+  import.pre-ack           appended + applied, not acked
+  oplog.fsync              inside fsync, concurrent ingest
+  fragment.snapshot.rename between snapshot temp write and rename
+  resize.drain.apply       mid-drain of queued resize writes (own test)
+
+Gated by PILOSA_TPU_PROC_TESTS=0 like tests/test_clusterproc.py.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.utils.faultpoints import EXIT_CODE
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PILOSA_TPU_PROC_TESTS", "1") == "0",
+    reason="process cluster tests disabled")
+
+_CWD = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class CrashNode:
+    """One restartable server subprocess on a fixed port + data dir."""
+
+    def __init__(self, port, datadir, extra_args=()):
+        self.port = port
+        self.datadir = datadir
+        self.extra_args = list(extra_args)
+        self.logpath = os.path.join(datadir, "server.log")
+        self.proc = None
+        self.client = Client(f"http://127.0.0.1:{port}",
+                             timeout=30, retries=0)
+
+    def spawn(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        log = open(self.logpath, "a")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "--bind", f"127.0.0.1:{self.port}",
+             "--data-dir", self.datadir,
+             "--fsync", "always", *self.extra_args],
+            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=_CWD)
+        log.close()
+        return self
+
+    def wait_ready(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"server exited rc={self.proc.returncode}: "
+                    + self.tail())
+            try:
+                self.client._request("GET", "/status")
+                return self
+            except Exception:
+                time.sleep(0.25)
+        raise TimeoutError("server not ready: " + self.tail())
+
+    def wait_crash(self, timeout=60):
+        """Block until the armed exit fires; assert the fault exit code."""
+        rc = self.proc.wait(timeout=timeout)
+        assert rc == EXIT_CODE, \
+            f"expected fault exit {EXIT_CODE}, got {rc}: " + self.tail()
+        return rc
+
+    def arm(self, *specs):
+        self.client._request(
+            "POST", "/debug/faultpoints",
+            json.dumps({"arm": list(specs)}).encode())
+
+    def tail(self):
+        try:
+            with open(self.logpath) as f:
+                return f.read()[-2000:]
+        except OSError:
+            return "<no log>"
+
+    def close(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _row_cols(client, index, row):
+    res = client.query(index, f"Row(f={row})")
+    return set(res["results"][0]["columns"])
+
+
+def test_crash_matrix_single_node():
+    datadir = tempfile.mkdtemp(prefix="pilosa-crashmx-")
+    # small max-op-n so bulk imports trip the snapshot path in round 4
+    cfg = os.path.join(datadir, "config.toml")
+    with open(cfg, "w") as f:
+        f.write("max-op-n = 8\n")
+    node = CrashNode(_free_ports(1)[0], datadir,
+                     extra_args=["--config", cfg])
+    try:
+        node.spawn().wait_ready()
+        node.client.create_index("cm")
+        node.client.create_field("cm", "f")
+
+        # -- round 1: crash after the oplog append, before apply --------
+        # The write is NOT acked (the connection dies), but it reached
+        # the durable log — boot replay must still apply it. This is the
+        # "replay may apply unacked writes" half of the contract.
+        node.arm("import.post-append=exit")
+        with pytest.raises(Exception):
+            node.client.import_bits("cm", "f", [1], [101])
+        node.wait_crash()
+        node.spawn().wait_ready()
+        assert 101 in _row_cols(node.client, "cm", 1), \
+            "appended record did not replay after crash: " + node.tail()
+
+        # -- round 2: crash after apply, before the ack returns ---------
+        node.arm("import.pre-ack=exit@3")
+        acked = []
+        for col in (201, 202, 203):
+            try:
+                node.client.import_bits("cm", "f", [2], [col])
+                acked.append(col)
+            except Exception:
+                break
+        assert acked == [201, 202]
+        node.wait_crash()
+        node.spawn().wait_ready()
+        got = _row_cols(node.client, "cm", 2)
+        assert set(acked) <= got, f"lost acked writes: {set(acked) - got}"
+
+        # -- round 3: crash inside fsync under concurrent ingest --------
+        node.arm("oplog.fsync=exit@40")
+        acked3, lock = [], threading.Lock()
+
+        def ingest(tid):
+            c = Client(f"http://127.0.0.1:{node.port}",
+                       timeout=10, retries=0)
+            for i in range(200):
+                col = 300 + tid * 1000 + i
+                try:
+                    c.import_bits("cm", "f", [3], [col])
+                except Exception:
+                    return
+                with lock:
+                    acked3.append(col)
+
+        threads = [threading.Thread(target=ingest, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        node.wait_crash(timeout=120)
+        for t in threads:
+            t.join(timeout=30)
+        assert acked3, "no writes acked before the fsync crash"
+        node.spawn().wait_ready()
+        got = _row_cols(node.client, "cm", 3)
+        missing = set(acked3) - got
+        assert not missing, f"lost {len(missing)} acked writes: " \
+            f"{sorted(missing)[:10]}..."
+
+        # -- round 4: crash between snapshot temp write and rename ------
+        # max-op-n=8: every batched import appends one op, so ~9 batches
+        # push a fragment over the threshold and the background snapshot
+        # dies at the rename point mid-ingest. @3: the fragment's op
+        # count carries over from round 3, so the first armed snapshot
+        # can fire before anything is acked — let two pass first.
+        node.arm("fragment.snapshot.rename=exit@3")
+        acked4 = []
+        for i in range(200):
+            cols = list(range(10_000 + i * 5, 10_000 + i * 5 + 5))
+            try:
+                node.client.import_bits("cm", "f", [4] * len(cols), cols)
+                acked4.extend(cols)
+            except Exception:
+                break
+            if node.proc.poll() is not None:
+                break
+        node.wait_crash(timeout=120)
+        assert acked4, "no writes acked before the snapshot crash"
+        node.spawn().wait_ready()
+        got = _row_cols(node.client, "cm", 4)
+        missing = set(acked4) - got
+        assert not missing, f"lost {len(missing)} acked writes " \
+            f"across snapshot crash: {sorted(missing)[:10]}..."
+
+        # fragment files still pass the consistency check
+        from pilosa_tpu.cli import main as cli_main
+
+        frag_files = []
+        for root, _dirs, files in os.walk(datadir):
+            frag_files += [os.path.join(root, fn) for fn in files
+                           if fn.isdigit()]
+        assert frag_files, "no fragment files found"
+        assert cli_main(["check", *frag_files]) == 0
+    finally:
+        node.close()
+        shutil.rmtree(datadir, ignore_errors=True)
+
+
+def test_crash_mid_resize_drain():
+    """Remove a node while importing: writes during RESIZING are queued
+    (and acked — they're in the oplog). Kill the coordinator on the 2nd
+    drained record; after restart, boot replay must deliver the whole
+    queued backlog and resize_replay_dropped must stay 0."""
+    ports = _free_ports(2)
+    hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+    dirs = [tempfile.mkdtemp(prefix="pilosa-crashrz-") for _ in ports]
+    nodes = [CrashNode(p, d, extra_args=[
+                 "--cluster-hosts", hosts, "--replicas", "1"])
+             for p, d in zip(ports, dirs)]
+    try:
+        for n in nodes:
+            n.spawn()
+        for n in nodes:
+            n.wait_ready()
+
+        # find the coordinator (it cannot be removed — remove the other)
+        st = nodes[0].client.status()
+        coord_uri = next(n["uri"] for n in st["nodes"]
+                         if n.get("isCoordinator"))
+        coord = next(n for n in nodes if str(n.port) in coord_uri)
+        victim = next(n for n in nodes if n is not coord)
+        victim_id = next(n["id"] for n in st["nodes"]
+                         if str(victim.port) in n["uri"])
+
+        coord.client.create_index("rz")
+        coord.client.create_field("rz", "f")
+        time.sleep(0.5)  # DDL broadcast settles
+        # spread shards so the victim owns several -> several delayed
+        # fetches -> a wide RESIZING window to queue writes into
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        base_cols = [s * SHARD_WIDTH + 1 for s in range(8)]
+        coord.client.import_bits("rz", "f", [1] * len(base_cols),
+                                 base_cols)
+
+        coord.arm("resize.fetch=delay:0.8",
+                  "resize.drain.apply=exit@2")
+        coord.client.resize_remove_node(victim_id)
+
+        # import while the (slowed) resize runs: these are queued + acked
+        acked = list(base_cols)
+        i = 0
+        while coord.proc.poll() is None and i < 400:
+            col = 500 + i
+            i += 1
+            try:
+                coord.client.import_bits("rz", "f", [1], [col])
+                acked.append(col)
+            except Exception:
+                break
+            time.sleep(0.01)
+        coord.wait_crash(timeout=120)
+        assert len(acked) > len(base_cols), \
+            "no writes were queued during the resize window"
+
+        # the coordinator saved the post-resize topology before draining,
+        # so it restarts as the sole node and replays the backlog locally
+        coord.spawn().wait_ready()
+        got = _row_cols(coord.client, "rz", 1)
+        missing = set(acked) - got
+        assert not missing, \
+            f"lost {len(missing)} acked writes across resize-drain " \
+            f"crash: {sorted(missing)[:10]}..."
+
+        # crash-window replay is NOT counted loss
+        dbg = coord.client._request("GET", "/debug/vars")
+        dropped = [v for k, v in dbg.items()
+                   if "resize_replay_dropped" in str(k)]
+        assert all(not v for v in dropped), \
+            f"resize_replay_dropped nonzero: {dropped}"
+    finally:
+        for n in nodes:
+            n.close()
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)

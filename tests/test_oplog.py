@@ -1,0 +1,639 @@
+"""Durable write-ahead oplog tests (storage/oplog.py + API threading).
+
+Unit level: record framing (CRC, torn tail), segment rotation,
+checkpoint truncation, the applied watermark. Integration level: the
+API appends before apply/ack, boot replay recovers a crash between
+append and apply, replay is idempotent (set bits) / last-write-wins
+(BSI values), the resize queue keeps its backlog durable, and the
+client backs off on 503 + Retry-After and enforces per-request
+deadlines.
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.server.api import API, ApiError, ServiceUnavailableError
+from pilosa_tpu.server.client import Client, ClientError, DeadlineExceeded
+from pilosa_tpu.storage import oplog as oplog_mod
+from pilosa_tpu.storage.oplog import OpLog
+from pilosa_tpu.utils import faultpoints
+from pilosa_tpu.utils.faultpoints import FaultInjected
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    yield
+    faultpoints.disarm()
+    oplog_mod.set_fsync_policy("never")
+
+
+def _records(n, start=0):
+    return [{"kind": "bits", "i": start + i} for i in range(n)]
+
+
+# -- record framing / torn tail ----------------------------------------------
+
+
+class TestOpLogUnit:
+    def test_append_replay_roundtrip(self, tmp_path):
+        log = OpLog(str(tmp_path / "oplog")).open()
+        for rec in _records(5):
+            log.append(rec)
+        got = list(log.replay())
+        assert [lsn for lsn, _ in got] == [1, 2, 3, 4, 5]
+        assert [r["i"] for _, r in got] == [0, 1, 2, 3, 4]
+
+    def test_lsns_survive_reopen(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path).open()
+        for rec in _records(3):
+            log.append(rec)
+        log2 = OpLog(path).open()
+        assert log2.append({"kind": "bits", "i": 99}) == 4
+
+    def test_crc_corruption_truncates_tail(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path).open()
+        for rec in _records(3):
+            log.append(rec)
+        log.close()
+        segs = sorted(f for f in os.listdir(path) if f.endswith(".wal"))
+        seg = os.path.join(path, segs[0])
+        # flip a byte inside the LAST record's payload
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.seek(size - 2)
+            b = f.read(1)
+            f.seek(size - 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        log2 = OpLog(path).open()
+        got = list(log2.replay())
+        assert [r["i"] for _, r in got] == [0, 1]
+        assert log2.summary()["truncated_tails"] == 1
+        # the log stays appendable after truncation, reusing the lsn
+        assert log2.append({"kind": "bits", "i": 2}) == 3
+
+    def test_partial_record_truncates_tail(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path).open()
+        for rec in _records(2):
+            log.append(rec)
+        log.close()
+        segs = sorted(f for f in os.listdir(path) if f.endswith(".wal"))
+        seg = os.path.join(path, segs[0])
+        with open(seg, "ab") as f:  # half a header: a torn final write
+            f.write(struct.pack("<I", 10))
+        log2 = OpLog(path).open()
+        assert [r["i"] for _, r in list(log2.replay())] == [0, 1]
+        assert os.path.getsize(seg) < 1000  # garbage gone from disk
+
+    def test_insane_length_prefix_is_torn(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path).open()
+        log.append({"kind": "bits", "i": 0})
+        log.close()
+        segs = sorted(f for f in os.listdir(path) if f.endswith(".wal"))
+        seg = os.path.join(path, segs[0])
+        with open(seg, "ab") as f:
+            f.write(struct.pack("<IIQ", 1 << 30, 0, 2) + b"xx")
+        log2 = OpLog(path).open()
+        assert [r["i"] for _, r in list(log2.replay())] == [0]
+
+    def test_torn_tail_drops_later_segments(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path, segment_max_bytes=1).open()  # rotate every rec
+        for rec in _records(4):
+            log.append(rec)
+        log.close()
+        segs = sorted(f for f in os.listdir(path) if f.endswith(".wal"))
+        assert len(segs) > 2
+        # corrupt the FIRST segment: everything after it was appended
+        # later in LSN order, but the prefix contract says replay stops
+        # at the first bad record — later segments must go too
+        first = os.path.join(path, segs[0])
+        with open(first, "r+b") as f:
+            f.seek(os.path.getsize(first) - 1)
+            f.write(b"\x00")
+        log2 = OpLog(path).open()
+        assert list(log2.replay()) == []
+        left = [f for f in os.listdir(path) if f.endswith(".wal")]
+        assert len(left) == 1  # only the fresh active segment
+
+    def test_rotation_seals_segments(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        rotated = []
+        log = OpLog(path, segment_max_bytes=1,
+                    on_rotate=rotated.append).open()
+        for rec in _records(3):
+            log.append(rec)
+        assert log.summary()["segments"] >= 3
+        assert rotated and rotated[0] == 1
+
+    def test_checkpoint_drops_applied_segments(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path, segment_max_bytes=64).open()
+        for rec in _records(4):
+            log.append(rec)
+        for lsn in (1, 2, 3, 4):
+            log.mark_applied(lsn)
+        assert log.checkpoint() == 4
+        assert list(log.replay()) == []
+        # sealed segments gone; reopen sees the checkpoint
+        log2 = OpLog(path).open()
+        assert log2.checkpoint_lsn == 4
+        assert list(log2.replay()) == []
+
+    def test_checkpoint_clamped_to_watermark(self, tmp_path):
+        log = OpLog(str(tmp_path / "oplog")).open()
+        for rec in _records(3):
+            log.append(rec)
+        log.mark_applied(1)
+        # lsn 2's apply is in flight: a checkpoint at 3 must not pass it
+        assert log.checkpoint(3) == 1
+        assert [lsn for lsn, _ in log.replay()] == [2, 3]
+
+    def test_watermark_needs_contiguity(self, tmp_path):
+        log = OpLog(str(tmp_path / "oplog")).open()
+        for rec in _records(3):
+            log.append(rec)
+        log.mark_applied(2)
+        log.mark_applied(3)
+        assert log.applied_lsn == 0
+        log.mark_applied(1)
+        assert log.applied_lsn == 3
+
+    def test_clean_close_checkpoints(self, tmp_path):
+        path = str(tmp_path / "oplog")
+        log = OpLog(path).open()
+        for rec in _records(3):
+            log.append(rec)
+        for lsn in (1, 2, 3):
+            log.mark_applied(lsn)
+        log.close()
+        log2 = OpLog(path).open()
+        assert list(log2.replay()) == []
+
+    @pytest.mark.parametrize("mode", ["always", "interval", "never"])
+    def test_fsync_modes_append(self, tmp_path, mode):
+        log = OpLog(str(tmp_path / "oplog"), fsync=mode,
+                    fsync_interval=0.01).open()
+        for rec in _records(3):
+            log.append(rec)
+        assert log.summary()["fsync"] == mode
+        assert [r["i"] for _, r in log.replay()] == [0, 1, 2]
+        log.close()
+
+    def test_bad_fsync_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            OpLog(str(tmp_path / "oplog"), fsync="sometimes")
+        with pytest.raises(ValueError):
+            oplog_mod.set_fsync_policy("sometimes")
+
+    def test_summary_fields(self, tmp_path):
+        log = OpLog(str(tmp_path / "oplog")).open()
+        for rec in _records(2):
+            log.append(rec)
+        log.mark_applied(1)
+        s = log.summary()
+        assert s["last_lsn"] == 2
+        assert s["applied_lsn"] == 1
+        assert s["replay_lag"] == 1
+        assert s["unapplied"] == 2
+        assert s["appends"] == 2
+        assert s["segment_files"]
+        compact = log.summary(compact=True)
+        assert "segment_files" not in compact
+
+
+# -- fragment-layer fsync policy sharing -------------------------------------
+
+
+class TestFsyncPolicySharing:
+    def test_fragment_append_honors_policy(self, tmp_path, monkeypatch):
+        synced = []
+        monkeypatch.setattr(oplog_mod, "fsync_file",
+                            lambda f, stat_name=None: synced.append(f))
+        holder = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        try:
+            idx = holder.create_index("i")
+            f = idx.create_field("f")
+            # only count syncs on this fragment's op file — a leftover
+            # interval-syncer thread may flush other tests' files here
+            def frag_syncs():
+                return [s for s in synced
+                        if "/fragments/" in getattr(s, "name", "")]
+            oplog_mod.set_fsync_policy("never")
+            f.set_bit(1, 1)
+            assert not frag_syncs()
+            oplog_mod.set_fsync_policy("always")
+            f.set_bit(1, 2)
+            assert frag_syncs()
+        finally:
+            oplog_mod.set_fsync_policy("never")
+            holder.close()
+
+    def test_fragment_sync_forces_fsync(self, tmp_path):
+        holder = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        try:
+            idx = holder.create_index("i")
+            f = idx.create_field("f")
+            f.set_bit(1, 1)
+            assert holder.sync_fragments() >= 1
+        finally:
+            holder.close()
+
+
+# -- API integration ----------------------------------------------------------
+
+
+def _mk_api(tmp_path, name="d"):
+    holder = Holder(str(tmp_path / name), use_snapshot_queue=False).open()
+    oplog = OpLog(str(tmp_path / name / "oplog")).open()
+    return holder, oplog, API(holder, oplog=oplog)
+
+
+def _frag_cols(holder, row=1):
+    f = holder.index("i").field("f")
+    view = f.view()
+    if view is None:
+        return set()
+    frag = view.fragment(0)
+    if frag is None:
+        return set()
+    return {int(c) for c in frag.row_columns(row)}
+
+
+class TestApiOplog:
+    def test_import_appends_then_applies(self, tmp_path):
+        from pilosa_tpu.core.field import FieldOptions
+
+        holder, oplog, api = _mk_api(tmp_path)
+        try:
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.create_field("i", "v", FieldOptions.int_field(0, 1000))
+            api.import_bits("i", "f", [1, 1], [2, 3])
+            api.import_values("i", "v", [2], [7])
+            assert oplog.last_lsn == 2
+            assert oplog.applied_lsn == 2
+            kinds = [r["kind"] for _, r in OpLog(oplog.path).open().replay()]
+            assert kinds == ["bits", "values"]
+        finally:
+            holder.close()
+
+    def test_crash_before_apply_replays_at_boot(self, tmp_path):
+        holder, oplog, api = _mk_api(tmp_path)
+        api.create_index("i")
+        api.create_field("i", "f")
+        faultpoints.arm("import.post-append=raise")
+        with pytest.raises(FaultInjected):
+            api.import_bits("i", "f", [1], [5])
+        faultpoints.disarm()
+        # appended, never applied — the crash window the oplog exists for
+        assert oplog.last_lsn == 1
+        assert 5 not in _frag_cols(holder)
+        # "restart": fresh API over the same dirs
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 1
+            assert 5 in {int(c) for c in
+                         api2.query("i", "Row(f=1)")[0].columns()}
+            # replay checkpointed: the NEXT boot replays nothing
+            assert oplog2.checkpoint_lsn == 1
+        finally:
+            holder2.close()
+            oplog2.close()
+
+    def test_replay_is_idempotent_for_set_bits(self, tmp_path):
+        holder, oplog, api = _mk_api(tmp_path)
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.import_bits("i", "f", [1, 1, 1], [5, 6, 7])
+        # crash post-apply, pre-checkpoint: restart replays the record
+        # over fragments that already contain it
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 1
+            assert api2.query("i", "Count(Row(f=1))")[0] == 3
+        finally:
+            holder2.close()
+            oplog2.close()
+
+    def test_bsi_replay_is_last_write_wins(self, tmp_path):
+        from pilosa_tpu.core.field import FieldOptions
+
+        holder, oplog, api = _mk_api(tmp_path)
+        api.create_index("i")
+        api.create_field("i", "v", FieldOptions.int_field(0, 1000))
+        api.import_values("i", "v", [2], [5])
+        api.import_values("i", "v", [2], [9])
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 2
+            got = {int(c) for c in
+                   api2.query("i", "Row(v == 9)")[0].columns()}
+            assert 2 in got
+            got5 = {int(c) for c in
+                    api2.query("i", "Row(v == 5)")[0].columns()}
+            assert 2 not in got5
+        finally:
+            holder2.close()
+            oplog2.close()
+
+    def test_roaring_import_replays(self, tmp_path):
+        from pilosa_tpu.roaring import Bitmap, serialize
+
+        holder, oplog, api = _mk_api(tmp_path)
+        api.create_index("i")
+        api.create_field("i", "f")
+        bm = Bitmap()
+        bm.add(3)  # row 0, col 3
+        api.import_roaring("i", "f", 0, serialize(bm))
+        assert oplog.applied_lsn == 1
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 1
+            assert 3 in {int(c) for c in
+                         api2.query("i", "Row(f=0)")[0].columns()}
+        finally:
+            holder2.close()
+            oplog2.close()
+
+    def test_failed_import_does_not_wedge_watermark(self, tmp_path):
+        holder, oplog, api = _mk_api(tmp_path)
+        try:
+            api.create_index("i")
+            api.create_field("i", "f")
+            api.import_bits("i", "f", [1], [1])
+            faultpoints.arm("import.pre-ack=raise")
+            with pytest.raises(FaultInjected):
+                api.import_bits("i", "f", [1], [2])
+            # the errored lsn is marked applied (no ack, no promise), so
+            # the watermark — and with it checkpointing — keeps moving
+            api.import_bits("i", "f", [1], [3])
+            assert oplog.applied_lsn == 3
+        finally:
+            holder.close()
+
+    def test_keyed_import_records_raw_keys(self, tmp_path):
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.core.index import IndexOptions
+
+        holder, oplog, api = _mk_api(tmp_path)
+        try:
+            api.create_index("ki", IndexOptions(keys=True))
+            api.create_field("ki", "kf", FieldOptions(keys=True))
+            api.import_bits("ki", "kf", [], [], row_keys=["r1", "r1"],
+                            column_keys=["c1", "c2"])
+            recs = list(OpLog(oplog.path).open().replay())
+            assert recs[0][1]["row_keys"] == ["r1", "r1"]
+            assert recs[0][1]["column_keys"] == ["c1", "c2"]
+        finally:
+            holder.close()
+
+    def test_timestamps_roundtrip_through_oplog(self, tmp_path):
+        from datetime import datetime
+
+        from pilosa_tpu.core.field import FieldOptions
+
+        holder, oplog, api = _mk_api(tmp_path)
+        api.create_index("i")
+        api.create_field("i", "t", FieldOptions.time_field("YMD"))
+        ts = datetime(2024, 3, 5, 10, 0)
+        api.import_bits("i", "t", [1], [4], timestamps=[ts])
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 1
+            r = api2.query(
+                "i", "Row(t=1, from=2024-03-04T00:00, to=2024-03-06T00:00)")
+            assert 4 in {int(c) for c in r[0].columns()}
+        finally:
+            holder2.close()
+            oplog2.close()
+
+
+# -- resize queue durability + 503 backpressure -------------------------------
+
+
+class TestResizeQueueDurability:
+    def _resizing_api(self, tmp_path):
+        holder = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api = API(holder, oplog=oplog)
+        api.create_index("i")
+        api.create_field("i", "f")
+        # minimal stand-in cluster: RESIZING state, single "node" so the
+        # drain's local apply path is taken
+        api.cluster = SimpleNamespace(state="RESIZING", nodes=[object()])
+        return holder, oplog, api
+
+    def test_queue_overflow_is_503_with_retry_after(self, tmp_path):
+        holder, oplog, api = self._resizing_api(tmp_path)
+        try:
+            api.RESIZE_QUEUE_MAX = 2
+            assert api.import_bits("i", "f", [1], [1]) == 0
+            assert api.import_bits("i", "f", [1], [2]) == 0
+            with pytest.raises(ServiceUnavailableError) as ei:
+                api.import_bits("i", "f", [1], [3])
+            assert ei.value.status == 503
+            assert ei.value.headers["Retry-After"] == str(
+                api.RESIZE_QUEUE_RETRY_AFTER)
+            # still an ApiError matching the pre-existing contract
+            assert isinstance(ei.value, ApiError)
+            assert "queue full" in str(ei.value)
+            # overflowed write was still durably appended BEFORE the
+            # rejection — harmless: replay re-queues or re-applies it
+            assert oplog.last_lsn == 3
+        finally:
+            holder.close()
+
+    def test_drain_marks_queued_records_applied(self, tmp_path):
+        holder, oplog, api = self._resizing_api(tmp_path)
+        try:
+            assert api.import_bits("i", "f", [1], [10]) == 0
+            assert api.import_bits("i", "f", [1], [11]) == 0
+            assert oplog.last_lsn == 2
+            assert oplog.applied_lsn == 0  # acked but queued
+            api.cluster.state = "NORMAL"
+            api._drain_resize_writes()
+            deadline = time.time() + 5
+            while time.time() < deadline and oplog.applied_lsn < 2:
+                time.sleep(0.02)
+            assert oplog.applied_lsn == 2
+            assert api.query("i", "Count(Row(f=1))")[0] == 2
+        finally:
+            holder.close()
+
+    def test_crash_with_queued_backlog_replays_at_boot(self, tmp_path):
+        holder, oplog, api = self._resizing_api(tmp_path)
+        acked = []
+        for col in (20, 21, 22):
+            assert api.import_bits("i", "f", [1], [col]) == 0
+            acked.append(col)
+        # crash before any drain: in-memory queue gone, oplog not
+        holder.close()
+        holder2 = Holder(str(tmp_path / "d"), use_snapshot_queue=False).open()
+        oplog2 = OpLog(str(tmp_path / "d" / "oplog")).open()
+        api2 = API(holder2, oplog=oplog2)
+        try:
+            assert api2.replay_oplog() == 3
+            got = {int(c) for c in api2.query("i", "Row(f=1)")[0].columns()}
+            assert set(acked) <= got
+        finally:
+            holder2.close()
+            oplog2.close()
+
+
+# -- client retry / deadline / Retry-After ------------------------------------
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    """Responds per the server-attached script: a list of
+    (status, headers, body) consumed one per request."""
+
+    def _serve(self):
+        self.server.hits.append(self.path)
+        if self.server.script:
+            status, headers, body = self.server.script.pop(0)
+        else:
+            status, headers, body = 200, {}, b"{}"
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = do_DELETE = _serve
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def scripted_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+    srv.script = []
+    srv.hits = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestClientResilience:
+    def _client(self, srv, **kw):
+        kw.setdefault("backoff", 0.01)
+        kw.setdefault("backoff_max", 0.05)
+        return Client("http://127.0.0.1:%d" % srv.server_address[1], **kw)
+
+    def test_503_retried_with_retry_after(self, scripted_server):
+        scripted_server.script = [
+            (503, {"Retry-After": "0.01"}, b'{"error": "resizing"}'),
+            (503, {"Retry-After": "0.01"}, b'{"error": "resizing"}'),
+            (200, {}, b'{"ok": true}'),
+        ]
+        c = self._client(scripted_server)
+        assert c._request("GET", "/status") == {"ok": True}
+        assert len(scripted_server.hits) == 3
+
+    def test_503_retries_exhausted_raises(self, scripted_server):
+        scripted_server.script = [
+            (503, {}, b'{"error": "nope"}')] * 10
+        c = self._client(scripted_server, retries=2)
+        with pytest.raises(ClientError) as ei:
+            c._request("GET", "/status")
+        assert ei.value.status == 503
+        assert len(scripted_server.hits) == 3  # 1 try + 2 retries
+
+    def test_non_idempotent_post_not_retried_on_network_error(self):
+        # nothing listens here: connection refused
+        c = Client("http://127.0.0.1:1", retries=3, backoff=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            c._request("POST", "/index/i/query", b"Set(1, f=1)")
+        assert time.monotonic() - t0 < 1.0
+        # ...but the idempotent import path IS retried
+        hits = []
+        orig = c._request_once
+
+        def counting(*a, **kw):
+            hits.append(1)
+            return orig(*a, **kw)
+
+        c._request_once = counting
+        with pytest.raises(OSError):
+            c.import_bits("i", "f", [1], [1])
+        assert len(hits) == 4  # 1 try + 3 retries
+
+    def test_deadline_exceeded(self, scripted_server):
+        scripted_server.script = [
+            (503, {"Retry-After": "30"}, b'{"error": "busy"}')] * 10
+        c = self._client(scripted_server, retries=8, backoff=0.05,
+                         backoff_max=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            c._request("GET", "/status", deadline=0.3)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_server_error_not_retried(self, scripted_server):
+        scripted_server.script = [(500, {}, b'{"error": "boom"}')] * 3
+        c = self._client(scripted_server)
+        with pytest.raises(ClientError):
+            c._request("GET", "/status")
+        assert len(scripted_server.hits) == 1
+
+
+# -- /debug/oplog over HTTP ---------------------------------------------------
+
+
+class TestDebugOplogEndpoint:
+    def test_debug_oplog(self, tmp_path):
+        from tests.harness import ServerHarness
+
+        h = ServerHarness(data_dir=str(tmp_path / "d"))
+        try:
+            out = h.client.debug_oplog()
+            assert out["enabled"] is False
+            h.api.oplog = OpLog(str(tmp_path / "d" / "oplog")).open()
+            h.api.oplog.append({"kind": "bits"})
+            out = h.client.debug_oplog()
+            assert out["enabled"] is True
+            assert out["last_lsn"] == 1
+            assert out["segment_files"]
+            # rolled into /status observability
+            st = h.client.status()
+            obs = st.get("observability", {})
+            local = obs.get("local")
+            if local is not None:  # only when an hbm-stats executor runs
+                assert "oplog" in local
+        finally:
+            h.close()
