@@ -14,11 +14,11 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	tests/test_bench_orchestrator.py tests/test_crashmatrix.py
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
-	test-flightrec test-devhealth test-explain test-durability lint \
-	bench-cpu
+	test-flightrec test-devhealth test-explain test-durability \
+	test-workload lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
-	test-explain test-durability
+	test-explain test-durability test-workload
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -49,6 +49,12 @@ test-explain:
 test-durability:
 	$(PY) -m pytest tests/test_oplog.py tests/test_faultpoints.py \
 		tests/test_crashmatrix.py $(PYTEST_FLAGS)
+
+# Workload observatory surface: query fingerprinting + the per-shape
+# stats table, the fragment heat ledger joined against HBM residency,
+# and SLO error-budget burn tracking (/debug/workload|heat|slo).
+test-workload:
+	$(PY) -m pytest tests/test_workload.py $(PYTEST_FLAGS)
 
 # Query observability surface: per-query profiles, histograms, the
 # slow-query log, trace retention, and the exposition formats.

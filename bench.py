@@ -281,6 +281,9 @@ def main():
             # the headline record too)
             "plan_nodes": served.get("plan_nodes"),
             "plan_strategy": served.get("plan_strategy"),
+            # top query shapes by frequency from the workload table —
+            # the headline record names what the served leg actually ran
+            "workload_top": served.get("workload_top"),
             "served_pct_of_kernel": round(
                 100 * served["served_qps"] / qps, 1)
             if "served_qps" in served else None,

@@ -337,6 +337,7 @@ def measure_served_1b(n_shards=954, workers=256, n_queries=4096,
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.exec import Executor
     from pilosa_tpu.shardwidth import WORDS_PER_ROW
+    from pilosa_tpu.utils import workload as _workload
 
     rng = np.random.default_rng(seed)
     planes = {}
@@ -417,6 +418,13 @@ def measure_served_1b(n_shards=954, workers=256, n_queries=4096,
             "queries_per_dispatch": round(batched / max(batches, 1), 1),
             "plan_nodes": sum(_nodes(c) for c in env["calls"]),
             "plan_strategy": env["calls"][0].get("strategy"),
+            # the workload table's view of the run: top shapes by
+            # frequency, so the bench record names what it actually ran
+            "workload_top": [
+                {"fingerprint": w["fingerprint"], "shape": w["shape"],
+                 "count": w["count"]}
+                for w in _workload.table().snapshot(top=3)
+                ["by_frequency"]],
             # per-kernel dispatch-phase RTT decomposition (lock_wait /
             # transfer_in / compile / dispatch_ack / sync seconds) —
             # rides the BENCH record so "65ms RTT" is attributable
@@ -1276,6 +1284,99 @@ def bench_durability_overhead():
                         "interval": round(p99_intv_ms, 3)}})
 
 
+# --------------------------------------------------------------- config 12
+
+def bench_workload_overhead():
+    """Workload observatory acceptance leg.
+
+    The claim, one JSON line: always-on query fingerprinting + the
+    per-fingerprint table fold + heat bumps + the SLO sample tick cost
+    <2% of an api_nop query. Asserted via the established microbenchmark
+    methodology (per-query instrumentation ns / query wall — stable
+    where an enabled-vs-disabled wall diff drowns in scheduler noise);
+    the leg also sanity-checks that the tracking actually tracked: the
+    table holds the benched fingerprint, the heat ledger is non-empty,
+    and /debug/slo-shaped burn state answers for a configured objective.
+    """
+    from pilosa_tpu.pql import parse
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import workload
+
+    platform, holder, api, ex = _env()
+    workload.reset()
+    workload.configure_slo(["query=250ms@p99"])
+    api.create_index("wl")
+    api.create_field("wl", "a")
+    api.create_field("wl", "b")
+    idx = holder.index("wl")
+    n_shards = 4 if platform != "cpu" else 2
+    rng = np.random.default_rng(29)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=100_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    idx.field("b").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+
+    api.executor = ex
+    st = ex._stacked
+    pql = "Count(Intersect(Row(a=1), Row(b=1)))"
+    api.query("wl", pql)  # warm stacks + compile
+
+    n_q = 50 if platform == "cpu" else 200
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("wl", pql)
+    enabled_ms = (time.perf_counter() - t0) / n_q * 1000
+
+    # per-query instrumentation microbenchmark: exactly what one query
+    # adds — fingerprint + begin/end (table fold), the two cache_stats
+    # snapshots, a couple of heat bumps, and the rate-limited SLO tick
+    query = parse(pql)
+    n_probe = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        wctx = workload.begin_query("wl", query)
+        before = st.counters()
+        workload.heat_bump("wl", "a", "standard")
+        workload.heat_bump("wl", "b", "standard")
+        after = st.counters()
+        workload.end_query(wctx, 0.001, deltas={
+            "dispatches": after[0] - before[0],
+            "cache_hits": after[1] - before[1],
+            "cache_misses": after[2] - before[2],
+            "bytes_materialized": 0})
+        workload.maybe_sample_slo()
+    per_query_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = per_query_ns / 1e6 / enabled_ms * 100
+    assert overhead_pct < 2.0, (
+        f"workload tracking costs {overhead_pct:.3f}% of an api_nop "
+        "query — no longer an always-on-safe default")
+
+    # the tracking tracked: table entry, heat, and burn state all live
+    snap = workload.table().snapshot(top=3)
+    assert snap["total_queries"] >= n_q
+    assert snap["by_frequency"], "no fingerprint entry after the bench"
+    heat_report = workload.heat().report(st.hbm_snapshot(top=0), top=5)
+    assert heat_report["tracked"] > 0, "heat ledger never bumped"
+    slo_snap = workload.slo().snapshot()
+    assert slo_snap["objectives"][0]["total_requests"] > 0
+
+    top = snap["by_frequency"][0]
+    workload.reset()
+    _close(holder)
+    _emit("workload_overhead_pct", overhead_pct, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "per_query_instrumentation_ns": round(per_query_ns, 1),
+        "api_nop_enabled_ms": round(enabled_ms, 3),
+        "overhead_pct": round(overhead_pct, 4),
+        "top_fingerprint": top["fingerprint"],
+        "top_shape": top["shape"],
+        "top_p99_ms": top["p99_ms"],
+        "heat_tracked": heat_report["tracked"],
+        "slo_burn_fast": slo_snap["objectives"][0]["burn_rate"]["fast"]})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -1288,6 +1389,7 @@ CONFIGS = {
     "devhealth_overhead": bench_devhealth_overhead,
     "explain_overhead": bench_explain_overhead,
     "durability_overhead": bench_durability_overhead,
+    "workload_overhead": bench_workload_overhead,
 }
 
 

@@ -300,6 +300,26 @@ def cmd_server(args):
             ring_size=int(prs) if prs is not None else None,
             misestimate_factor=float(emf) if emf is not None else None)
 
+    # SLO objectives: error-budget burn rate over the existing timing
+    # histograms (utils/workload.py module state). Accepts a repeated
+    # --slo flag (list) or a comma-separated string from the config file.
+    slo_cfg = config.get("slo")
+    if slo_cfg:
+        from .utils import workload as _workload
+
+        if isinstance(slo_cfg, str):
+            slo_specs = [s.strip() for s in slo_cfg.split(",") if s.strip()]
+        else:
+            slo_specs = []
+            for item in slo_cfg:
+                slo_specs.extend(
+                    s.strip() for s in str(item).split(",") if s.strip())
+        burn = config.get("slo-burn-threshold")
+        _workload.configure_slo(
+            slo_specs,
+            burn_threshold=float(burn) if burn is not None else None,
+            logger=_FrLogger())
+
     # Trace retention (GET /debug/traces): "memory" installs a bounded
     # InMemoryTracer ring; the default keeps the nop tracer, whose hot
     # path allocates no spans at all (query profiles via ?profile=true /
@@ -751,7 +771,8 @@ def _apply_server_flags(config, args):
                  "max_writes_per_request", "tracing", "workers",
                  "flight_recorder_size", "watchdog_deadline",
                  "plan_ring_size", "explain_misestimate_factor",
-                 "device_probe_interval", "device_probe_deadline"):
+                 "device_probe_interval", "device_probe_deadline",
+                 "slo", "slo_burn_threshold"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -938,6 +959,15 @@ def main(argv=None):
                         "500ms): background canary dispatches drive the "
                         "LIVE/DEGRADED/DOWN readiness state at /readyz "
                         "and /debug/device; disabled when unset")
+    p.add_argument("--slo", action="append", default=None,
+                   help="latency objective as name=threshold@quantile "
+                        "(e.g. query=50ms@p99); repeatable. Tracked as "
+                        "multi-window error-budget burn at /debug/slo "
+                        "and slo_burn_rate gauges")
+    p.add_argument("--slo-burn-threshold", type=float, default=None,
+                   help="burn-rate multiple that must be exceeded in "
+                        "BOTH the fast and slow windows before "
+                        "slo.burn_alert fires (default 6.0)")
     p.add_argument("--device-probe-deadline", default=None,
                    help="per-canary deadline (e.g. 5s) before a probe "
                         "counts as a device-link failure (default 5s)")
@@ -1045,6 +1075,8 @@ def main(argv=None):
     p.add_argument("--explain-misestimate-factor", type=float, default=None)
     p.add_argument("--device-probe-interval", default=None)
     p.add_argument("--device-probe-deadline", default=None)
+    p.add_argument("--slo", action="append", default=None)
+    p.add_argument("--slo-burn-threshold", type=float, default=None)
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"])
     p.add_argument("--no-oplog", action="store_true", default=False)

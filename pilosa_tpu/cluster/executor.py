@@ -18,6 +18,7 @@ and only per-node partial results cross the DCN as JSON.
 
 import os
 import threading
+import time as _time
 
 from ..core.row import Row
 from ..exec.executor import ExecOptions, Executor
@@ -221,22 +222,45 @@ class ClusterExecutor:
         if explain == "plan":
             return self._explain_cluster_plan(idx, query, shards, opt)
 
-        plan_calls = [] if explain == "analyze" else None
-        results = []
-        for call in query.calls:
-            if plan_calls is None:
-                results.append(self._execute_call(idx, call, shards, opt))
-                continue
-            # ?explain=analyze: every fan-out leg runs its own analyze
-            # and hands back a sub-plan; the coordinator node wraps them
-            sink = []
-            results.append(
-                self._execute_call(idx, call, shards, opt, plan_sink=sink))
-            plan_calls.append(
-                self._cluster_plan_node(idx, call, shards, sink))
-        if plan_calls is not None:
-            self._stash_cluster_plan(idx, "analyze", plan_calls, shards)
-        return translate_results(idx, query.calls, results)
+        # The coordinator fingerprints the whole query; remote legs
+        # carry opt.remote so they never record themselves, and local
+        # legs go through execute_call (not execute), so this is the
+        # single recording site for a fanned-out query.
+        from ..utils import workload as workload_mod
+
+        wctx = workload_mod.begin_query(idx.name, query)
+        before = self.local._stacked.counters()
+        t_query = _time.perf_counter()
+        try:
+            plan_calls = [] if explain == "analyze" else None
+            results = []
+            for call in query.calls:
+                if plan_calls is None:
+                    results.append(self._execute_call(idx, call, shards, opt))
+                    continue
+                # ?explain=analyze: every fan-out leg runs its own analyze
+                # and hands back a sub-plan; the coordinator node wraps them
+                sink = []
+                results.append(
+                    self._execute_call(idx, call, shards, opt, plan_sink=sink))
+                plan_calls.append(
+                    self._cluster_plan_node(idx, call, shards, sink))
+            if plan_calls is not None:
+                self._stash_cluster_plan(idx, "analyze", plan_calls, shards)
+            return translate_results(idx, query.calls, results)
+        finally:
+            if wctx is not None:
+                from ..shardwidth import WORDS_PER_ROW
+
+                after = self.local._stacked.counters()
+                workload_mod.end_query(
+                    wctx, _time.perf_counter() - t_query, deltas={
+                        "dispatches": after[0] - before[0],
+                        "cache_hits": after[1] - before[1],
+                        "cache_misses": after[2] - before[2],
+                        "bytes_materialized":
+                            (after[3] - before[3]) * WORDS_PER_ROW * 4,
+                    })
 
     def _cluster_plan_node(self, idx, call, shards, children):
         """The coordinator's node for one fanned-out call: per-node

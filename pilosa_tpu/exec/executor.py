@@ -262,56 +262,82 @@ class Executor:
 
         from ..utils import profile as profile_mod
         from ..utils import tracing
+        from ..utils import workload as workload_mod
         from ..utils.stats import global_stats
 
         import time as _time
 
-        # Per-query stacked-counter deltas: with a profile active, the
-        # before/after cache_stats diff attributes dispatches, cache
-        # traffic, and upload bytes to THIS query. The evaluator is
-        # shared, so concurrent queries can bleed into each other's
-        # deltas — still the right order of magnitude, and exact when
-        # queries are serialized (the acceptance path).
+        # Per-query stacked-counter deltas: the before/after cache_stats
+        # diff attributes dispatches, cache traffic, and upload bytes to
+        # THIS query — for the profile when one is active, and for the
+        # always-on workload fingerprint table on every non-remote query
+        # (remote fan-out legs don't fingerprint themselves, matching
+        # the profile rule: the coordinator's entry covers them). The
+        # evaluator is shared, so concurrent queries can bleed into each
+        # other's deltas — still the right order of magnitude, and exact
+        # when queries are serialized (the acceptance path).
         prof = profile_mod.current()
+        wctx = None if opt.remote else workload_mod.begin_query(
+            idx.name, query)
+        wl_before = self._stacked.counters() if wctx is not None else None
         before = self._stacked.cache_stats() if prof is not None else None
 
         plan_nodes = [] if explain == "analyze" else None
         results = []
-        with tracing.start_span(
-                "executor.Execute", index=index_name) as span:
-            for call in query.calls:
-                t_call = _time.perf_counter()
-                with tracing.start_span(f"executor.execute{call.name}"):
-                    if plan_nodes is None:
-                        results.append(
-                            self.execute_call(idx, call, shards, opt))
-                    else:
-                        result, node = self.explain_analyze_call(
-                            idx, call, shards, opt)
-                        results.append(result)
-                        plan_nodes.append(node)
-                # per-PQL-op latency histogram (global registry: the
-                # executor predates any per-server stats wiring, and
-                # registry_of() resolves /metrics to this same registry)
-                global_stats.timing(
-                    "query_op_seconds", _time.perf_counter() - t_call,
-                    {"op": call.name})
-            if span is not None:
-                span.set_tag("calls", len(query.calls))
+        t_query = _time.perf_counter()
+        try:
+            with tracing.start_span(
+                    "executor.Execute", index=index_name) as span:
+                for call in query.calls:
+                    t_call = _time.perf_counter()
+                    with tracing.start_span(
+                            f"executor.execute{call.name}"):
+                        if plan_nodes is None:
+                            results.append(
+                                self.execute_call(idx, call, shards, opt))
+                        else:
+                            result, node = self.explain_analyze_call(
+                                idx, call, shards, opt)
+                            results.append(result)
+                            plan_nodes.append(node)
+                    # per-PQL-op latency histogram (global registry: the
+                    # executor predates any per-server stats wiring, and
+                    # registry_of() resolves /metrics to this registry)
+                    global_stats.timing(
+                        "query_op_seconds", _time.perf_counter() - t_call,
+                        {"op": call.name})
+                if span is not None:
+                    span.set_tag("calls", len(query.calls))
 
-        if prof is not None:
-            after = self._stacked.cache_stats()
-            prof.set_tag("shards_touched",
-                         len(self._call_shards(idx, shards)))
-            for key, tag in (("dispatches", "dispatches"),
-                             ("pairwise_dispatches", "pairwise_dispatches"),
-                             ("pairwise_syncs", "pairwise_syncs"),
-                             ("hits", "cache_hits"),
-                             ("misses", "cache_misses")):
-                prof.add(tag, after[key] - before[key])
-            prof.add("bytes_materialized",
-                     (after["planes_uploaded"] - before["planes_uploaded"])
-                     * WORDS_PER_ROW * 4)
+            if prof is not None:
+                after = self._stacked.cache_stats()
+                prof.set_tag("shards_touched",
+                             len(self._call_shards(idx, shards)))
+                for key, tag in (("dispatches", "dispatches"),
+                                 ("pairwise_dispatches",
+                                  "pairwise_dispatches"),
+                                 ("pairwise_syncs", "pairwise_syncs"),
+                                 ("hits", "cache_hits"),
+                                 ("misses", "cache_misses")):
+                    prof.add(tag, after[key] - before[key])
+                prof.add("bytes_materialized",
+                         (after["planes_uploaded"]
+                          - before["planes_uploaded"])
+                         * WORDS_PER_ROW * 4)
+        finally:
+            # even a failed query records its shape — a recurring error
+            # shape is exactly what the workload view should surface
+            if wctx is not None:
+                wl_after = self._stacked.counters()
+                workload_mod.end_query(
+                    wctx, _time.perf_counter() - t_query, deltas={
+                        "dispatches": wl_after[0] - wl_before[0],
+                        "cache_hits": wl_after[1] - wl_before[1],
+                        "cache_misses": wl_after[2] - wl_before[2],
+                        "bytes_materialized":
+                            (wl_after[3] - wl_before[3])
+                            * WORDS_PER_ROW * 4,
+                    })
 
         if plan_nodes is not None:
             from . import plan as plan_mod
@@ -326,7 +352,10 @@ class Executor:
             # only misestimated plans earn a ring slot: the ring is the
             # triage queue for cost-model drift, not a second query log
             if any(n.misestimates for n in plan_nodes):
-                plan_mod.record(env)
+                plan_mod.record(
+                    env,
+                    fingerprint=wctx.fingerprint
+                    if wctx is not None else None)
 
         if not opt.remote:
             results = translate_results(idx, query.calls, results)
@@ -362,15 +391,19 @@ class Executor:
 
     def _note_strategy(self, op, strategy, **detail):
         """Record the strategy a decision point ACTUALLY took. Feeds the
-        analyze grafting (thread-local notes) and, when a profile is
-        active, the profile's `strategies` tag — which is what SLOW QUERY
-        lines print, so a wedge can be triaged from logs alone."""
+        analyze grafting (thread-local notes), the workload fingerprint
+        table's per-shape strategy distribution (always on), and, when a
+        profile is active, the profile's `strategies` tag — which is
+        what SLOW QUERY lines print, so a wedge can be triaged from logs
+        alone."""
         from ..utils import profile as profile_mod
+        from ..utils import workload as workload_mod
 
+        workload_mod.note_strategy(op, strategy)
         notes = getattr(self._explain_tls, "notes", None)
         prof = profile_mod.current()
         if notes is None and prof is None:
-            return  # nothing listening: stay off the hot path
+            return  # nothing else listening: stay off the hot path
         entry = {"op": op, "strategy": strategy}
         entry.update(detail)
         if notes is not None:
@@ -437,10 +470,31 @@ class Executor:
         for child in call.children:
             self.validate_bitmap_call(idx, child)
 
+    def _bump_fallback_heat(self, idx, call):
+        """Host-fallback accesses feed the fragment heat ledger too: a
+        working set that never enters the stacked path must still look
+        hot to the admission policy (the stacked cache probes in
+        exec/stacked.py cover the cached path). One bump per Row/Range
+        leaf per query — demand frequency, not shard fan-out."""
+        from ..utils import workload as workload_mod
+
+        if call.name in ("Row", "Range") and call.args:
+            from ..pql.ast import is_reserved_arg
+
+            field_name = next(
+                (k for k in call.args if not is_reserved_arg(k)), None)
+            if field_name is not None \
+                    and idx.field(field_name) is not None:
+                workload_mod.heat_bump(
+                    idx.name, field_name, VIEW_STANDARD)
+        for child in call.children:
+            self._bump_fallback_heat(idx, child)
+
     def _exec_bitmap_call(self, idx, call, shards, opt):
         import jax
 
         self.validate_bitmap_call(idx, call)
+        self._bump_fallback_heat(idx, call)
         # Dispatch every shard's plane chain asynchronously (fanned over
         # the worker pool), then fetch all result planes in ONE
         # device->host transfer (the per-shard chains themselves never

@@ -33,7 +33,7 @@ knows how much to trust it.
 """
 
 import threading
-from collections import deque
+from collections import OrderedDict
 
 from ..shardwidth import WORDS_PER_ROW
 from ..utils.stats import global_stats
@@ -55,19 +55,28 @@ DISPATCH_FLOOR = 1.0
 BYTES_FLOOR = 1 << 16
 
 _lock = threading.Lock()
-_ring = deque(maxlen=DEFAULT_PLAN_RING)
+#: retained plans keyed by workload fingerprint (or a per-record
+#: sequence number when none is known): one HOT mis-modeled shape keeps
+#: ONE slot — latest plan + repeat count — instead of evicting every
+#: other entry from the ring
+_ring = OrderedDict()
+_ring_max = DEFAULT_PLAN_RING
+_anon_seq = 0
 _local = threading.local()
 _misestimate_factor = DEFAULT_MISESTIMATE_FACTOR
 _misestimates_flagged = 0  # cumulative, for the observability roll-up
+_repeats_collapsed = 0     # re-records absorbed by fingerprint dedupe
 
 
 def configure(ring_size=None, misestimate_factor=None):
     """Apply --plan-ring-size / --explain-misestimate-factor. Resizing
-    keeps the newest entries (deque semantics)."""
-    global _ring, _misestimate_factor
+    keeps the newest entries (ring semantics)."""
+    global _ring_max, _misestimate_factor
     with _lock:
         if ring_size is not None:
-            _ring = deque(_ring, maxlen=max(1, int(ring_size)))
+            _ring_max = max(1, int(ring_size))
+            while len(_ring) > _ring_max:
+                _ring.popitem(last=False)
         if misestimate_factor is not None:
             _misestimate_factor = float(misestimate_factor)
 
@@ -76,16 +85,35 @@ def misestimate_factor():
     return _misestimate_factor
 
 
-def record(plan):
-    """Retain one (misestimated) plan dict in the /debug/plans ring."""
+def record(plan, fingerprint=None):
+    """Retain one (misestimated) plan dict in the /debug/plans ring.
+    With a fingerprint, a repeat replaces that shape's slot (latest plan
+    wins, `repeat_count` accumulates); without one the entry is
+    standalone."""
+    global _anon_seq, _repeats_collapsed
     with _lock:
-        _ring.append(plan)
+        if fingerprint is None:
+            _anon_seq += 1
+            key = f"#{_anon_seq}"
+        else:
+            key = fingerprint
+        old = _ring.pop(key, None)
+        entry = dict(plan)
+        entry["repeat_count"] = 1 if old is None \
+            else old.get("repeat_count", 1) + 1
+        if old is not None:
+            _repeats_collapsed += 1
+        if fingerprint is not None:
+            entry["fingerprint"] = fingerprint
+        _ring[key] = entry
+        while len(_ring) > _ring_max:
+            _ring.popitem(last=False)
 
 
 def recent(limit=None):
     """Retained plans, newest first (GET /debug/plans)."""
     with _lock:
-        out = list(_ring)
+        out = list(_ring.values())
     out.reverse()
     if limit is not None:
         out = out[: max(0, int(limit))]
@@ -93,23 +121,28 @@ def recent(limit=None):
 
 
 def clear_recent():
-    global _misestimates_flagged
+    global _misestimates_flagged, _repeats_collapsed
     with _lock:
         _ring.clear()
         _misestimates_flagged = 0
+        _repeats_collapsed = 0
 
 
 def stats():
     """Roll-up summary for /status observability."""
     with _lock:
-        return {"retained": len(_ring), "ring_size": _ring.maxlen,
+        return {"retained": len(_ring), "ring_size": _ring_max,
                 "misestimates_flagged": _misestimates_flagged,
+                "repeats_collapsed": _repeats_collapsed,
                 "misestimate_factor": _misestimate_factor}
 
 
 def _count_misestimate(op):
     global _misestimates_flagged
+    from ..utils import workload
+
     global_stats.count("explain_misestimates", 1, {"op": op})
+    workload.note_misestimate()  # attribute to the in-flight fingerprint
     with _lock:
         _misestimates_flagged += 1
 

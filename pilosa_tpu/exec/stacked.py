@@ -39,6 +39,7 @@ import numpy as np
 from ..utils import flightrec as _flightrec
 from ..utils import profile as _profile
 from ..utils import tracing as _tracing
+from ..utils import workload as _workload
 from ..utils.stats import global_stats
 
 
@@ -538,6 +539,18 @@ class StackedEvaluator:
             return self._rows_stacks, MAX_ROWS_STACK_BYTES
         return self._stacks, MAX_STACK_BYTES
 
+    @staticmethod
+    def _heat_key(key):
+        """(index, field, view) for the fragment heat ledger. Leaf and
+        rows stacks cache the standard view (rows keys carry the actual
+        view name at key[3] — time-quantum views differ); BSI stacks
+        cache the field's BSI bit planes."""
+        if key[0] == "rows":
+            return key[1], key[2], key[3]
+        if key[0] == "bsi":
+            return key[1], key[2], "bsi"
+        return key[1], key[2], VIEW_STANDARD
+
     def _cache_get_fast(self, key, stamp):
         """O(1) hit check via the view-level (uid, mutations) stamp — the
         first level of the two-level fingerprint. A stamp match proves no
@@ -552,8 +565,14 @@ class StackedEvaluator:
                 pool.move_to_end(key)
                 hit[4] = time.time()  # last-hit age for /debug/hbm
                 self.hits += 1
-                return hit[1]
-        return None
+                hit = hit[1]
+            else:
+                hit = None
+        if hit is not None:
+            # heat rides every probe that RESOLVED here (outside the
+            # evaluator lock: the ledger has its own)
+            _workload.heat_bump(*self._heat_key(key))
+        return hit
 
     def _cache_get(self, key, gens, stamp=None):
         """Second-level check: exact per-shard generations. On a hit the
@@ -570,9 +589,14 @@ class StackedEvaluator:
                     hit[3] = stamp
                 hit[4] = time.time()
                 self.hits += 1
-                return hit[1]
-            self.misses += 1
-        return None
+                hit = hit[1]
+            else:
+                self.misses += 1
+                hit = None
+        # misses bump too: demand for an absent fragment is precisely
+        # what makes it an admission candidate in /debug/heat
+        _workload.heat_bump(*self._heat_key(key))
+        return hit
 
     def _ledger_key(self, key):
         """Every cache key carries (kind, index, field, ...) at positions
@@ -1560,6 +1584,16 @@ class StackedEvaluator:
         if bool(use_neg):
             mag = -mag
         return mag, combine_hi_lo(c_hi, c_lo)
+
+    def counters(self):
+        """(dispatches, hits, misses, planes_uploaded) — the per-query
+        delta source for the always-on workload table. A bare tuple read
+        instead of the full cache_stats() dict: this runs twice per
+        query, and the workload_overhead bench gates the sum at <2% of
+        query wall."""
+        with self._lock:
+            return (self.dispatches, self.hits, self.misses,
+                    self.planes_uploaded)
 
     def cache_stats(self):
         """Snapshot for /debug/vars: hit rate and byte pressure reveal

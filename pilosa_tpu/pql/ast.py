@@ -60,6 +60,41 @@ class Call:
     def has_conditions(self):
         return any(isinstance(v, Condition) for v in self.args.values())
 
+    def shape(self):
+        """Literal-free normal form for workload fingerprinting
+        (utils/workload.py): call name, arg KEYS (field names), condition
+        operators, and child nesting survive; row ids, values, and time
+        bounds collapse to `_`. `field=`/`_field=` values ARE field names,
+        so they survive too — Rows(f) and Rows(g) are different shapes,
+        Row(f=3) and Row(f=9) are the same shape."""
+        out = []
+        self._shape_into(out)
+        return "".join(out)
+
+    def _shape_into(self, out):
+        # append-based builder: shape() runs once per served query, and
+        # nested f-string joins were the single largest per-query cost
+        # in the workload_overhead bench
+        out.append(self.name)
+        out.append("(")
+        sep = ""
+        for c in self.children:
+            out.append(sep)
+            c._shape_into(out)
+            sep = ","
+        for key in sorted(self.args):
+            out.append(sep)
+            sep = ","
+            value = self.args[key]
+            if key in ("field", "_field"):
+                out.append(f"{key}={value}")
+            elif isinstance(value, Condition):
+                out.append(f"{key}{value.op}_")
+            else:
+                out.append(key)
+                out.append("=_")
+        out.append(")")
+
     def __eq__(self, other):
         return (isinstance(other, Call) and self.name == other.name
                 and self.args == other.args and self.children == other.children)
@@ -84,6 +119,16 @@ class Query:
 
     def write_calls(self):
         return [c for c in self.calls if c.writes()]
+
+    def shape(self):
+        """Normalized shape of the whole query (see Call.shape)."""
+        out = []
+        sep = ""
+        for c in self.calls:
+            out.append(sep)
+            c._shape_into(out)
+            sep = ";"
+        return "".join(out)
 
     def __eq__(self, other):
         return isinstance(other, Query) and self.calls == other.calls

@@ -576,6 +576,11 @@ class API:
             if prof is not None:
                 prof.finish()
         self._log_slow_query(index_name, pql, time.monotonic() - t0, prof)
+        # SLO tick: with objectives configured, serving traffic alone
+        # keeps burn rates fresh and fires alerts (rate-limited inside;
+        # a scrape-free deployment still alerts)
+        from ..utils import workload as workload_mod
+        workload_mod.maybe_sample_slo()
         if any(c.writes() for c in query.calls):
             self._broadcast_shards_if_changed(index_name)
         return results
@@ -634,17 +639,24 @@ class API:
             import json as _json
 
             from ..utils import flightrec
+            from ..utils import workload as workload_mod
 
             q = pql if isinstance(pql, str) else str(pql)
+            # the executor just finished this query on THIS thread, so
+            # its fingerprint is in take-last position — slow lines for
+            # the same shape grep together across the fleet
+            fp = workload_mod.last_fingerprint() or "-"
             flightrec.record("query.slow", index=index_name,
-                             seconds=round(elapsed, 3), pql=q[:200])
+                             seconds=round(elapsed, 3), pql=q[:200],
+                             fingerprint=fp)
             if prof is not None:
-                # trace= and plan= ride ahead of profile=, which stays
-                # the LAST field: consumers parse the profile JSON as
-                # everything after "profile=" (tests pin this format)
-                # analyze queries stamp a full summary (with ! marking
-                # misestimated ops); otherwise derive one from whatever
-                # strategy notes the decision points emitted
+                # trace=, plan=, and fingerprint= ride ahead of
+                # profile=, which stays the LAST field: consumers parse
+                # the profile JSON as everything after "profile=" (tests
+                # pin this format). analyze queries stamp a full summary
+                # (with ! marking misestimated ops); otherwise derive
+                # one from whatever strategy notes the decision points
+                # emitted
                 plan = prof.tag("plan_summary")
                 if not plan:
                     strategies = prof.tag("strategies")
@@ -652,14 +664,14 @@ class API:
                         f"{s.get('op', '?')}={s.get('strategy', '?')}"
                         for s in strategies) if strategies else "-"
                 self.logger.printf(
-                    "%.03fs SLOW QUERY index=%s %s trace=%s plan=%s "
-                    "profile=%s", elapsed, index_name, q[:500],
-                    prof.root.trace_id, plan,
+                    "%.03fs SLOW QUERY index=%s %s trace=%s fingerprint=%s "
+                    "plan=%s profile=%s", elapsed, index_name,
+                    q[:500], prof.root.trace_id, fp, plan,
                     _json.dumps(prof.to_dict()))
             else:
                 self.logger.printf(
-                    "%.03fs SLOW QUERY index=%s %s", elapsed, index_name,
-                    q[:500])
+                    "%.03fs SLOW QUERY index=%s %s fingerprint=%s",
+                    elapsed, index_name, q[:500], fp)
 
     # -- schema DDL ---------------------------------------------------------
 
@@ -1246,6 +1258,7 @@ class API:
         /debug/kernels, and /debug/device)."""
         from ..exec import plan as plan_mod
         from ..utils import devhealth
+        from ..utils import workload as workload_mod
 
         local = getattr(self.executor, "local", self.executor)
         if not hasattr(local, "hbm_stats"):
@@ -1262,6 +1275,12 @@ class API:
                 for kind, v in sorted(kernels.items())},
             "plans": plan_mod.stats(),
             "device_link": devhealth.summary(),
+            # workload observatory roll-up: what runs, what's hot, and
+            # whether serving is inside its objectives (full rankings
+            # live at /debug/workload, /debug/heat, /debug/slo)
+            "workload": workload_mod.table().summary(),
+            "heat": workload_mod.heat().summary(),
+            "slo": workload_mod.slo().summary(),
         }
         if self.oplog is not None:
             out["oplog"] = self.oplog.summary(compact=True)
@@ -1305,6 +1324,32 @@ class API:
                                 ("fsync", "last_lsn", "checkpoint_lsn",
                                  "replay_lag", "unapplied", "segments",
                                  "truncated_tails")}
+            # workload observatory roll-up (top=0/1: counters, not
+            # rankings — the full views stay on each node's debug
+            # endpoints)
+            wl = client.debug_workload(top=1)
+            out["workload"] = {k: wl.get(k) for k in
+                               ("total_queries", "unique_fingerprints",
+                                "evicted")}
+            top_freq = wl.get("by_frequency") or []
+            out["workload"]["top"] = {
+                k: top_freq[0].get(k)
+                for k in ("fingerprint", "shape", "count")} \
+                if top_freq else None
+            ht = client.debug_heat(top=0)
+            out["heat"] = {
+                "tracked": ht.get("tracked"),
+                "hot_but_not_resident":
+                    ht.get("hot_but_not_resident_total"),
+                "resident_but_cold":
+                    ht.get("resident_but_cold_total")}
+            sl = client.debug_slo()
+            out["slo"] = {
+                "objectives": len(sl.get("objectives") or []),
+                "alerting": [o.get("name")
+                             for o in sl.get("objectives") or []
+                             if o.get("alerting")],
+                "alerts_total": sl.get("alerts_total")}
             return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}

@@ -315,6 +315,27 @@ class Client:
         replay lag); {"enabled": False} when the node runs without one."""
         return self._request("GET", "/debug/oplog")
 
+    def debug_workload(self, top=None):
+        """The peer's per-fingerprint workload table (top-K rankings);
+        top=1 fetches the headline entry only."""
+        path = "/debug/workload"
+        if top is not None:
+            path += f"?top={int(top)}"
+        return self._request("GET", path)
+
+    def debug_heat(self, top=None):
+        """The peer's fragment heat ledger joined against HBM
+        residency; top=0 fetches totals without the ranked lists."""
+        path = "/debug/heat"
+        if top is not None:
+            path += f"?top={int(top)}"
+        return self._request("GET", path)
+
+    def debug_slo(self):
+        """The peer's SLO burn-rate state (objectives, windows,
+        alerting flags)."""
+        return self._request("GET", "/debug/slo")
+
     def debug_flightrecorder(self, limit=None):
         """The peer's flight-recorder tail."""
         path = "/debug/flightrecorder"

@@ -145,6 +145,7 @@ class PilosaHTTPServer:
             Route("POST", r"/cluster/resize/set-coordinator",
                   self._set_coordinator),
             Route("GET", r"/metrics", self._get_metrics),
+            Route("GET", r"/debug", self._get_debug_index),
             Route("GET", r"/debug/vars", self._get_debug_vars),
             Route("GET", r"/debug/queries", self._get_debug_queries),
             Route("GET", r"/debug/plans", self._get_debug_plans,
@@ -159,6 +160,11 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/device", self._get_debug_device,
                   args=("limit",)),
             Route("GET", r"/debug/dispatch", self._get_debug_dispatch),
+            Route("GET", r"/debug/workload", self._get_debug_workload,
+                  args=("top",)),
+            Route("GET", r"/debug/heat", self._get_debug_heat,
+                  args=("top",)),
+            Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
             Route("GET", r"/debug/faultpoints", self._get_faultpoints),
             Route("POST", r"/debug/faultpoints", self._post_faultpoints),
@@ -679,6 +685,74 @@ class PilosaHTTPServer:
         if not hasattr(local, "dispatch_phase_stats"):
             raise NotFoundError("no stacked evaluator on this node")
         return local.dispatch_phase_stats()
+
+    #: every debug endpoint with a one-line description — served at
+    #: GET /debug so discoverability doesn't depend on the README
+    DEBUG_ENDPOINTS = {
+        "/debug/vars": "expvar-style counters, gauges, and p50/p99 "
+                       "timing summaries",
+        "/debug/queries": "recent per-query profiles (span tree + "
+                          "dispatch/lock/cache counters), newest first",
+        "/debug/traces": "retained raw spans (needs --tracing memory)",
+        "/debug/plans": "misestimated EXPLAIN ANALYZE plans, deduped "
+                        "per query fingerprint, newest first",
+        "/debug/hbm": "HBM ledger: resident stack-cache bytes per "
+                      "(index, field, pool) + device headroom",
+        "/debug/kernels": "per-kernel-family dispatch counts, wall, and "
+                          "modeled costs",
+        "/debug/device": "device-link health: canary probe state "
+                         "machine, RTT samples, transitions",
+        "/debug/dispatch": "dispatch-phase RTT decomposition (lock_wait "
+                           "/ transfer_in / compile / ack / sync)",
+        "/debug/workload": "query fingerprint table: per-shape counts, "
+                           "p50/p99, strategies, misestimates",
+        "/debug/heat": "fragment heat vs HBM residency: admission and "
+                       "eviction candidates",
+        "/debug/slo": "SLO objectives and multi-window error-budget "
+                      "burn rates",
+        "/debug/oplog": "write-ahead oplog: LSNs, checkpoint, fsync "
+                        "policy, segment state",
+        "/debug/flightrecorder": "black-box event ring (dispatches, "
+                                 "cache churn, stalls, alerts)",
+        "/debug/faultpoints": "fault-injection points (GET state, POST "
+                              "to arm)",
+        "/debug/pprof/goroutine": "all-thread stack dump",
+    }
+
+    def _get_debug_index(self, req):
+        """GET /debug: enumerate every debug endpoint (the list outgrew
+        the README)."""
+        return {"endpoints": [
+            {"path": path, "description": desc}
+            for path, desc in sorted(self.DEBUG_ENDPOINTS.items())]}
+
+    def _get_debug_workload(self, req):
+        """Query fingerprint table: top-K shapes by frequency, total
+        wall, and misestimate rate (utils/workload.py). ?top=0 returns
+        counters only (the coordinator roll-up shape)."""
+        from ..utils import workload as workload_mod
+
+        return workload_mod.table().snapshot(
+            top=int(self._q1(req, "top", "20")))
+
+    def _get_debug_heat(self, req):
+        """Fragment heat cross-referenced against the HBM ledger:
+        hot-but-not-resident (admission candidates) and
+        resident-but-cold (eviction candidates)."""
+        from ..utils import workload as workload_mod
+
+        local = self._local_executor()
+        hbm = local.hbm_stats(top=0) \
+            if hasattr(local, "hbm_stats") else None
+        return workload_mod.heat().report(
+            hbm, top=int(self._q1(req, "top", "50")))
+
+    def _get_debug_slo(self, req):
+        """SLO objectives with fast/slow-window error-budget burn rates
+        (empty objectives list when no --slo is configured)."""
+        from ..utils import workload as workload_mod
+
+        return workload_mod.slo().snapshot()
 
     def _get_debug_oplog(self, req):
         """Durable-oplog summary: segments, checkpoint, replay lag."""

@@ -39,6 +39,8 @@ Event taxonomy (kind prefixes; see docs/architecture.md):
   http.5xx     handler failures
   cluster.*    membership transitions, resize lifecycle, replay drops
   watchdog.*   stall trips
+  slo.burn_alert  error-budget burn over threshold in BOTH windows
+                  (utils/workload.py SloEngine; edge-triggered)
 """
 
 import collections

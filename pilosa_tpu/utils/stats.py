@@ -58,6 +58,19 @@ def _quantile(count, bucket_counts, q):
     return TIMING_BUCKETS[-1]
 
 
+def tail_count(bucket_counts, threshold_seconds):
+    """Observations ABOVE `threshold_seconds` from per-bucket counts
+    (+Inf last, aligned to TIMING_BUCKETS). The threshold snaps UP to
+    the nearest bucket bound — bucket resolution is the guarantee, so an
+    SLO threshold between bounds under-counts rather than over-counts.
+    Thresholds past the largest finite bound (10s) are untrackable and
+    return 0."""
+    i = bisect.bisect_left(TIMING_BUCKETS, threshold_seconds)
+    if i >= len(TIMING_BUCKETS):
+        return 0
+    return sum(bucket_counts[i + 1:])
+
+
 class StatsClient:
     def __init__(self):
         self._lock = threading.Lock()

@@ -1,0 +1,433 @@
+"""Workload observatory (utils/workload.py): PQL fingerprinting + the
+per-shape stats table, the fragment heat ledger joined against the HBM
+ledger, SLO error-budget burn tracking, and the HTTP/cluster surface.
+
+The acceptance contract (ISSUE 8): two queries with identical shape and
+different literals share ONE fingerprint entry; /debug/heat returns a
+non-empty hot_but_not_resident AND resident_but_cold under a constrained
+cache budget; an injected latency spike drives the burn rate over
+threshold and records slo.burn_alert.
+"""
+
+import json
+import re
+
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec import plan as plan_mod
+from pilosa_tpu.exec import stacked
+from pilosa_tpu.pql import parse
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import flightrec
+from pilosa_tpu.utils import profile as profile_mod
+from pilosa_tpu.utils import workload
+from pilosa_tpu.utils.logger import CaptureLogger
+from pilosa_tpu.utils.stats import StatsClient, global_stats
+from tests.harness import ClusterHarness, ServerHarness
+
+N_SHARDS = 3  # >= MIN_SHARDS so the stacked cache engages
+
+
+@pytest.fixture(autouse=True)
+def _pristine_workload():
+    workload.reset()
+    plan_mod.clear_recent()
+    yield
+    workload.reset()
+    plan_mod.clear_recent()
+
+
+@pytest.fixture
+def env(tmp_path):
+    h = Holder(str(tmp_path / "data"), use_snapshot_queue=False).open()
+    idx = h.create_index("i")
+    idx.create_field("a")
+    idx.create_field("b")
+    cols = [s * SHARD_WIDTH + off
+            for s in range(N_SHARDS) for off in (0, 3, 7, 11)]
+    idx.field("a").import_bits([i % 3 for i in range(len(cols))], cols)
+    idx.field("b").import_bits([i % 2 for i in range(len(cols))], cols)
+    e = Executor(h)
+    yield h, e
+    h.close()
+
+
+# ------------------------------------------------------------ fingerprints
+
+
+def test_fingerprint_strips_literals_keeps_shape():
+    """The normalization oracle: literals collapse, structure survives."""
+    fp = lambda q: workload.fingerprint("i", parse(q))[0]  # noqa: E731
+    # same shape, different row ids / values / time bounds -> same hash
+    assert fp("Count(Row(f=3))") == fp("Count(Row(f=999))")
+    assert fp("Row(v > 10)") == fp("Row(v > 7777)")
+    assert fp("Row(f=1, from='2020-01-01T00:00', to='2020-02-01T00:00')") \
+        == fp("Row(f=1, from='2024-06-01T00:00', to='2024-07-01T00:00')")
+    # different field, op, call, nesting, or index -> different hash
+    assert fp("Count(Row(f=3))") != fp("Count(Row(g=3))")
+    assert fp("Row(v > 10)") != fp("Row(v < 10)")
+    assert fp("Count(Row(f=3))") != fp("Row(f=3)")
+    assert fp("Intersect(Row(f=1), Row(g=1))") \
+        != fp("Union(Row(f=1), Row(g=1))")
+    assert workload.fingerprint("i", parse("Row(f=1)"))[0] \
+        != workload.fingerprint("j", parse("Row(f=1)"))[0]
+    # stable across parses (content hash, no per-process seed)
+    assert fp("GroupBy(Rows(f), limit=10)") == fp("GroupBy(Rows(f), limit=99)")
+
+
+def test_executor_folds_same_shape_into_one_entry(env):
+    """Different literals share one table entry; a different field gets
+    its own. Deltas and wall accumulate."""
+    _, e = env
+    for row in (0, 1, 2):
+        e.execute("i", f"Count(Row(a={row}))")
+    e.execute("i", "Count(Row(b=0))")
+
+    snap = workload.table().snapshot(top=10)
+    assert snap["total_queries"] == 4
+    assert snap["unique_fingerprints"] == 2
+    by_count = {e_["shape"]: e_ for e_ in snap["by_frequency"]}
+    a_entry = by_count["i:Count(Row(a=_))"]
+    assert a_entry["count"] == 3
+    assert a_entry["total_wall_seconds"] > 0
+    assert a_entry["dispatches"] >= 0
+    assert by_count["i:Count(Row(b=_))"]["count"] == 1
+
+
+def test_strategy_distribution_lands_on_fingerprint(env):
+    """_note_strategy decision points attribute to the in-flight query's
+    entry even without a profile active."""
+    _, e = env
+    e.execute("i", "Count(Row(a=1))")
+    snap = workload.table().snapshot(top=5)
+    strategies = snap["by_frequency"][0]["strategies"]
+    assert strategies, "no strategy recorded for the executed query"
+    assert all("=" in s for s in strategies)
+
+
+def test_table_bounded_lru_eviction():
+    t = workload.WorkloadTable(max_entries=4)
+    for i in range(6):
+        t.record(f"fp{i}", f"shape{i}", "i", 0.001)
+    snap = t.snapshot(top=10)
+    assert snap["unique_fingerprints"] == 4
+    assert snap["evicted"] == 2
+    assert snap["total_queries"] == 6
+    kept = {e["fingerprint"] for e in snap["by_frequency"]}
+    assert kept == {"fp2", "fp3", "fp4", "fp5"}  # oldest two evicted
+    # a re-recorded survivor moves to MRU and survives the next insert
+    t.record("fp2", "shape2", "i", 0.001)
+    t.record("fp6", "shape6", "i", 0.001)
+    kept = {e["fingerprint"]
+            for e in t.snapshot(top=10)["by_frequency"]}
+    assert "fp2" in kept and "fp3" not in kept
+
+
+# ------------------------------------------------------------------- heat
+
+
+def test_heat_decay_halves_per_half_life():
+    led = workload.HeatLedger(half_life=1.0)
+    led.bump("i", "f", "standard", now=100.0)
+    led.bump("i", "f", "standard", now=100.0)  # 2.0 at t=100
+    snap = led.snapshot(now=101.0)  # one half-life later
+    assert snap[0]["heat"] == pytest.approx(1.0, abs=1e-6)
+    snap = led.snapshot(now=103.0)  # three half-lives
+    assert snap[0]["heat"] == pytest.approx(0.25, abs=1e-6)
+    # a touch decays-then-adds: 2.0 * 0.5 + 1 = 2.0
+    led.bump("i", "f", "standard", now=101.0)
+    snap = led.snapshot(now=101.0)
+    assert snap[0]["heat"] == pytest.approx(2.0, abs=1e-6)
+    assert snap[0]["touches"] == 3
+
+
+def test_heat_report_joins_residency():
+    """hot-but-not-resident and resident-but-cold against a seeded HBM
+    snapshot."""
+    led = workload.HeatLedger(half_life=300.0)
+    led.bump("i", "hot_gone", "standard", amount=5.0, now=100.0)
+    led.bump("i", "hot_here", "standard", amount=5.0, now=100.0)
+    led.bump("i", "cold_here", "standard", amount=0.01, now=100.0)
+    hbm = {"by_index_field": [
+        {"index": "i", "field": "hot_here", "pool": "stack", "bytes": 4096},
+        {"index": "i", "field": "cold_here", "pool": "stack", "bytes": 8192},
+    ]}
+    rep = led.report(hbm, top=10, now=100.0)
+    assert [(e["index"], e["field"]) for e in rep["hot_but_not_resident"]] \
+        == [("i", "hot_gone")]
+    assert [(e["index"], e["field"]) for e in rep["resident_but_cold"]] \
+        == [("i", "cold_here")]
+    assert rep["resident_but_cold"][0]["bytes"] == 8192
+    assert rep["hot_but_not_resident_total"] == 1
+    assert rep["resident_but_cold_total"] == 1
+    # top-N heat exported as gauges
+    _, gauges, _ = global_stats.snapshot()
+    assert any(k[0] == "fragment_heat" and v > 0 for k, v in gauges.items())
+
+
+def test_heat_both_lists_under_constrained_budget(tmp_path, monkeypatch):
+    """The acceptance path: a cache budget too small for the working set
+    leaves evicted-but-demanded fields hot and resident fields cold."""
+    monkeypatch.setattr(stacked, "MAX_STACK_BYTES", 4096)
+    h = Holder(str(tmp_path / "data"), use_snapshot_queue=False).open()
+    try:
+        idx = h.create_index("w")
+        cols = [s * SHARD_WIDTH + off
+                for s in range(N_SHARDS) for off in (0, 5)]
+        for name in ("f0", "f1", "f2", "f3"):
+            idx.create_field(name)
+            idx.field(name).import_bits([1] * len(cols), cols)
+        e = Executor(h)
+        for name in ("f0", "f1", "f2", "f3"):
+            e.execute("w", f"Count(Row({name}=1))")
+
+        hbm = e.hbm_stats(top=0)
+        resident = {(r["index"], r["field"])
+                    for r in hbm["by_index_field"]}
+        assert resident, "nothing resident — cache never engaged"
+        tracked = {(k[0], k[1]) for k in workload.heat()._heat}
+        evicted = tracked - resident
+        assert evicted, "budget fit the whole working set — not constrained"
+
+        # age every entry far past the half-life (all cold), then re-touch
+        # one EVICTED field so it is hot without being resident
+        with workload.heat()._lock:
+            for entry in workload.heat()._heat.values():
+                entry[1] -= 3600.0
+        hot_idx, hot_field = next(iter(evicted))
+        workload.heat_bump(hot_idx, hot_field, "standard", amount=5.0)
+
+        rep = workload.heat().report(e.hbm_stats(top=0), top=10)
+        hot_missing = [(x["index"], x["field"])
+                       for x in rep["hot_but_not_resident"]]
+        assert (hot_idx, hot_field) in hot_missing
+        assert rep["resident_but_cold"], \
+            "aged resident entries did not surface as eviction candidates"
+        assert all(x["heat"] < workload.HEAT_HOT_MIN
+                   for x in rep["resident_but_cold"])
+    finally:
+        h.close()
+
+
+# -------------------------------------------------------------------- SLO
+
+
+def test_parse_slo_specs():
+    o = workload.parse_slo("query=50ms@p99")
+    assert (o.name, o.threshold_seconds, o.quantile) == ("query", 0.05, 0.99)
+    assert o.budget == pytest.approx(0.01)
+    o = workload.parse_slo("http=1s@p99.9")
+    assert o.threshold_seconds == 1.0
+    assert o.quantile == pytest.approx(0.999)
+    o = workload.parse_slo("query.GroupBy=250us@p95")
+    assert o.threshold_seconds == pytest.approx(250e-6)
+    for bad in ("nounit=50@p99", "noq=50ms", "q=50ms@99", "q=0ms@p99",
+                "=50ms@p99", "q=50ms@p0", "q=50ms@p100"):
+        with pytest.raises(ValueError):
+            workload.parse_slo(bad)
+
+
+def test_slo_burn_trajectory_and_alert():
+    """Good traffic burns ~0; an injected spike drives both windows over
+    threshold, fires ONE edge-triggered slo.burn_alert, and re-arms only
+    after the fast window recovers."""
+    stats = StatsClient()
+    eng = workload.SloEngine(stats=stats)
+    eng.configure([workload.parse_slo("query=1ms@p90")], burn_threshold=2.0)
+
+    t0 = 1000.0
+    for _ in range(100):  # healthy baseline: all under threshold
+        stats.timing("query_op_seconds", 0.0001, {"op": "Count"})
+    eng.sample(now=t0, force=True)
+    burns = eng.sample(now=t0 + 1, force=True)
+    assert burns["query"]["fast"] == 0.0
+
+    for _ in range(50):  # the spike: every request blows the objective
+        stats.timing("query_op_seconds", 0.5, {"op": "Count"})
+    flightrec.configure(256)
+    burns = eng.sample(now=t0 + 2, force=True)
+    # 50 bad / 150 in-window, budget 0.1 -> burn ~3.33 in both windows
+    assert burns["query"]["fast"] > 2.0
+    assert burns["query"]["slow"] > 2.0
+    assert eng.alerts_total == 1
+    events = [e for e in flightrec.snapshot()["events"]
+              if e["kind"] == "slo.burn_alert"]
+    assert len(events) == 1
+    assert events[0]["tags"]["objective"] == "query"
+    assert events[0]["tags"]["burn_fast"] > 2.0
+
+    # still burning: edge-triggered, no second alert
+    eng.sample(now=t0 + 3, force=True)
+    assert eng.alerts_total == 1
+
+    # recovery: a flood of good requests pulls the fast window back under
+    for _ in range(5000):
+        stats.timing("query_op_seconds", 0.0001, {"op": "Count"})
+    burns = eng.sample(now=t0 + 30, force=True)
+    assert burns["query"]["fast"] <= 2.0
+    snap = eng.snapshot()
+    assert snap["objectives"][0]["alerting"] is False
+    assert snap["alerts_total"] == 1
+
+
+def test_slo_gauges_and_snapshot_shape():
+    workload.configure_slo(["wl_probe_seconds=1ms@p90"], burn_threshold=3.0)
+    for _ in range(10):
+        global_stats.timing("wl_probe_seconds", 0.5)
+    workload.slo().sample(force=True)
+    snap = workload.slo().snapshot()
+    obj = snap["objectives"][0]
+    assert obj["spec"] == "wl_probe_seconds=1ms@p90"
+    assert obj["total_requests"] >= 10
+    assert obj["over_threshold"] >= 10
+    assert set(obj["burn_rate"]) == {"fast", "slow"}
+    # the scrape-time gauges exist for both windows
+    _, gauges, _ = global_stats.snapshot()
+    windows = {dict(tags).get("window") for (name, tags) in gauges
+               if name == "slo_burn_rate"
+               and dict(tags).get("objective") == "wl_probe_seconds"}
+    assert windows == {"fast", "slow"}
+    with pytest.raises(ValueError):
+        workload.configure_slo(["broken spec"])
+
+
+# ------------------------------------------------------- plan-ring dedupe
+
+
+def test_plan_ring_dedupes_by_fingerprint():
+    """Repeats of one misestimated shape hold ONE ring slot with a
+    repeat count; anonymous records keep plain ring semantics."""
+    for i in range(3):
+        plan_mod.record({"index": "i", "seq": i}, fingerprint="abcd")
+    got = plan_mod.recent()
+    assert len(got) == 1
+    assert got[0]["repeat_count"] == 3
+    assert got[0]["fingerprint"] == "abcd"
+    assert got[0]["seq"] == 2  # latest plan wins the slot
+    assert plan_mod.stats()["repeats_collapsed"] == 2
+    # a different fingerprint gets its own slot, newest first
+    plan_mod.record({"index": "i"}, fingerprint="efgh")
+    assert [p.get("fingerprint") for p in plan_mod.recent()] \
+        == ["efgh", "abcd"]
+
+
+def test_misestimates_attribute_to_fingerprint(env, monkeypatch):
+    """A wildly wrong cost estimate counts against the in-flight query's
+    fingerprint entry AND dedupes its retained plans."""
+    _, e = env
+    from pilosa_tpu.exec.executor import ExecOptions
+
+    monkeypatch.setattr(plan_mod.CostModel, "dispatch_seconds",
+                        lambda self, family: (100.0, "default"))
+    for row in (1, 2):
+        e.execute("i", f"Count(Row(a={row}))",
+                  options=ExecOptions(explain="analyze"))
+    snap = workload.table().snapshot(top=5)
+    entry = snap["by_misestimate_rate"][0]
+    assert entry["misestimates"] >= 2
+    assert entry["misestimate_rate"] > 0
+    # both analyze runs share one fingerprint -> one retained plan
+    plans = plan_mod.recent()
+    assert len(plans) == 1
+    assert plans[0]["repeat_count"] == 2
+    assert plans[0]["fingerprint"] == entry["fingerprint"]
+
+
+# ----------------------------------------------------------- HTTP surface
+
+
+def test_debug_endpoints_over_http(tmp_path):
+    h = ServerHarness(data_dir=str(tmp_path))
+    try:
+        h.client.create_index("hx")
+        h.client.create_field("hx", "f")
+        h.client.query("hx", "Set(1, f=10)")
+        h.client.query("hx", "Count(Row(f=10))")
+        h.client.query("hx", "Count(Row(f=11))")
+
+        wl = h.client.debug_workload(top=5)
+        assert wl["total_queries"] >= 3
+        shapes = [e["shape"] for e in wl["by_frequency"]]
+        assert "hx:Count(Row(f=_))" in shapes
+        count_entry = next(e for e in wl["by_frequency"]
+                           if e["shape"] == "hx:Count(Row(f=_))")
+        assert count_entry["count"] == 2  # literal-invariant
+
+        ht = h.client.debug_heat(top=5)
+        assert set(ht) >= {"tracked", "entries", "hot_but_not_resident",
+                           "resident_but_cold", "half_life_seconds"}
+
+        workload.configure_slo(["query=10s@p99"])
+        sl = h.client.debug_slo()
+        assert sl["objectives"][0]["spec"] == "query=10s@p99"
+        assert sl["windows"] == {"fast_seconds": 60.0,
+                                 "slow_seconds": 600.0}
+
+        # the index page enumerates every debug endpoint
+        index = h.client._request("GET", "/debug")
+        paths = {e["path"] for e in index["endpoints"]}
+        assert {"/debug/workload", "/debug/heat", "/debug/slo",
+                "/debug/vars", "/debug/hbm", "/debug/plans"} <= paths
+        assert all(e["description"] for e in index["endpoints"])
+    finally:
+        h.close()
+
+
+def test_slow_query_log_carries_fingerprint(tmp_path):
+    """SLOW QUERY lines gain fingerprint=; profile= stays the LAST field
+    so the established JSON parsing keeps working."""
+    h = ServerHarness(data_dir=str(tmp_path))
+    try:
+        log = CaptureLogger()
+        h.api.long_query_time = 0.0  # everything is slow
+        h.api.logger = log
+        profile_mod.clear_recent()
+        h.client.create_index("sq")
+        h.client.create_field("sq", "f")
+        h.client.query("sq", "Set(1, f=10)")
+        h.client.query("sq", "Count(Row(f=10))")
+
+        slow = [line for line in log.lines if "SLOW QUERY" in line]
+        assert slow
+        line = slow[-1]
+        m = re.search(r"fingerprint=([0-9a-f]{16})", line)
+        assert m, f"no fingerprint= field in: {line}"
+        expected, _ = workload.fingerprint(
+            "sq", parse("Count(Row(f=10))"))
+        assert m.group(1) == expected
+        json.loads(line.split("profile=", 1)[1])  # still last, still JSON
+    finally:
+        h.close()
+
+
+def test_cluster_status_rolls_up_observatory(tmp_path):
+    """The coordinator's /status?observability=true carries workload,
+    heat, and slo summaries for EVERY node."""
+    c = ClusterHarness(2)
+    try:
+        coord = c.node_by_id(c[0].cluster.coordinator.id)
+        coord.client.create_index("ci")
+        coord.client.create_field("ci", "f")
+        for col in (1, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 1):
+            coord.client.query("ci", f"Set({col}, f=7)")
+        for row in (7, 8, 9, 10):  # 4 reads > 3 writes: Count is top
+            coord.client.query("ci", f"Count(Row(f={row}))")
+
+        status = coord.client._request(
+            "GET", "/status?observability=true")
+        obs = status["observability"]
+        assert len(obs) == 2
+        for node_id, summary in obs.items():
+            assert "error" not in summary, \
+                f"peer fetch degraded for {node_id}: {summary}"
+            assert set(summary) >= {"workload", "heat", "slo"}
+            assert summary["slo"]["objectives"] == 0
+        # the coordinator fingerprinted the fanned-out query
+        local = obs[coord.cluster.local_id]
+        assert local["workload"]["total_queries"] >= 1
+        assert local["workload"]["top"]["shape"].endswith(
+            "Count(Row(f=_))")
+    finally:
+        c.close()
