@@ -15,10 +15,10 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
-	test-workload lint bench-cpu
+	test-workload test-batching lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
-	test-explain test-durability test-workload
+	test-explain test-durability test-workload test-batching
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -55,6 +55,13 @@ test-durability:
 # and SLO error-budget burn tracking (/debug/workload|heat|slo).
 test-workload:
 	$(PY) -m pytest tests/test_workload.py $(PYTEST_FLAGS)
+
+# Batched dispatch pipeline surface: vmapped batch kernels (bucket
+# padding, bit-identity vs serial), the query coalescer (fusing,
+# overload 503s, window=0 legacy identity), the query-batch route,
+# /debug/batching, and batch= attribution in SLOW QUERY / ANALYZE.
+test-batching:
+	$(PY) -m pytest tests/test_batching.py $(PYTEST_FLAGS)
 
 # Query observability surface: per-query profiles, histograms, the
 # slow-query log, trace retention, and the exposition formats.

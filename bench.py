@@ -530,6 +530,23 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         except Exception:  # noqa: BLE001 — the child may be truly wedged
             return None
 
+    def fetch_dispatch():
+        """Child's dispatch-phase RTT aggregate (same debug port serves
+        /debug/dispatch): a missed-deadline kill record carries WHICH
+        phase (lock_wait / transfer_in / compile / ack / sync) the
+        wedged round trips were sitting in, not just that they hung."""
+        if debug_port[0] is None:
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{debug_port[0]}/debug/dispatch",
+                    timeout=2) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — the child may be truly wedged
+            return None
+
     def pump_out():
         for line in proc.stdout:
             out_lines.append(line)
@@ -550,6 +567,7 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         phase = "main" if probe_ok.is_set() else "probe"
         tail = fetch_flightrec()
         dev = fetch_device()
+        disp = fetch_dispatch()
         proc.kill()
         proc.wait()
         te.join(timeout=5)
@@ -566,6 +584,8 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         rec["phase"] = phase
         if tail is not None:
             rec["flightrec"] = tail
+        if disp is not None:
+            rec["dispatch_phases"] = disp.get("phases", disp)
         if dev is not None:
             last = dev.get("last") or {}
             rtt = last.get("rtt_seconds")

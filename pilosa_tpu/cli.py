@@ -217,6 +217,11 @@ def cmd_server(args):
     # flag-merged by _apply_server_flags.
     lqt = config.get("long-query-time")
     mwpr = config.get("max-writes-per-request", 0)
+    # Query coalescer (batched dispatch pipeline): window 0 — the
+    # default — keeps the legacy per-query path bit-identical.
+    cw = config.get("coalesce-window")
+    coalesce_window = parse_duration(str(cw)) if cw else 0.0
+    coalesce_max_queue = int(config.get("coalesce-max-queue", 256))
     spmd = None
     if spmd_requested and cluster is not None:
         from .cluster.spmd import SpmdDataPlane
@@ -229,7 +234,9 @@ def cmd_server(args):
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
               max_writes_per_request=int(mwpr),
-              spmd=spmd, oplog=oplog)
+              spmd=spmd, oplog=oplog,
+              coalesce_window=coalesce_window,
+              coalesce_max_queue=coalesce_max_queue)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -293,12 +300,14 @@ def cmd_server(args):
     # (exec/plan.py module state, like the flight recorder above).
     prs = config.get("plan-ring-size")
     emf = config.get("explain-misestimate-factor")
-    if prs is not None or emf is not None:
+    if prs is not None or emf is not None or coalesce_window > 0:
         from .exec import plan as _plan
 
         _plan.configure(
             ring_size=int(prs) if prs is not None else None,
-            misestimate_factor=float(emf) if emf is not None else None)
+            misestimate_factor=float(emf) if emf is not None else None,
+            coalesce_window=coalesce_window if coalesce_window > 0
+            else None)
 
     # SLO objectives: error-budget burn rate over the existing timing
     # histograms (utils/workload.py module state). Accepts a repeated
@@ -772,7 +781,8 @@ def _apply_server_flags(config, args):
                  "flight_recorder_size", "watchdog_deadline",
                  "plan_ring_size", "explain_misestimate_factor",
                  "device_probe_interval", "device_probe_deadline",
-                 "slo", "slo_burn_threshold"):
+                 "slo", "slo_burn_threshold",
+                 "coalesce_window", "coalesce_max_queue"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -971,6 +981,16 @@ def main(argv=None):
     p.add_argument("--device-probe-deadline", default=None,
                    help="per-canary deadline (e.g. 5s) before a probe "
                         "counts as a device-link failure (default 5s)")
+    p.add_argument("--coalesce-window", default=None,
+                   help="query coalescer window (e.g. 2ms): concurrent "
+                        "batchable queries arriving within it fuse into "
+                        "one vmapped batched dispatch, amortizing the "
+                        "dispatch RTT (default 0 = disabled, legacy "
+                        "per-query path)")
+    p.add_argument("--coalesce-max-queue", type=int, default=None,
+                   help="coalesce queue cap: past it, queries get 503 + "
+                        "Retry-After instead of unbounded wait "
+                        "(default 256)")
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"],
                    help="durability fsync policy for the write-ahead "
@@ -1077,6 +1097,8 @@ def main(argv=None):
     p.add_argument("--device-probe-deadline", default=None)
     p.add_argument("--slo", action="append", default=None)
     p.add_argument("--slo-burn-threshold", type=float, default=None)
+    p.add_argument("--coalesce-window", default=None)
+    p.add_argument("--coalesce-max-queue", type=int, default=None)
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"])
     p.add_argument("--no-oplog", action="store_true", default=False)

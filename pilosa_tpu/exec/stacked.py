@@ -245,6 +245,63 @@ MAX_TIME_VIEWS = 64
 
 _OPS = {"Intersect": "&", "Union": "|", "Difference": "-", "Xor": "^"}
 
+#: vmapped-batch padding buckets: a coalesced batch is padded up to the
+#: next bucket (repeating query 0) so at most len(BATCH_BUCKETS) programs
+#: compile per (kind, signature) while any concurrency level still fuses
+#: into one dispatch. 64 caps per-dispatch device time near the tunnel
+#: RTT it amortizes (same reasoning as MAX_COUNT_BATCH).
+BATCH_BUCKETS = (1, 4, 16, 64)
+
+
+def batch_bucket(n):
+    """Smallest padding bucket holding `n` queries."""
+    for b in BATCH_BUCKETS:
+        if n <= b:
+            return b
+    return BATCH_BUCKETS[-1]
+
+
+#: process-wide dispatch-phase aggregate, folded by _note_phases in
+#: lockstep with each evaluator's own table: the bare flightrec debug
+#: server (bench children run no PilosaHTTPServer) serves it at
+#: GET /debug/dispatch without a handle on any evaluator, so a killed
+#: bench attempt still carries which phase its dispatches wedged in.
+_GLOBAL_PHASES = {}
+_GLOBAL_PHASES_LOCK = threading.Lock()
+
+
+def global_dispatch_phases():
+    """{kernel: {phase: {count, seconds}}} across every evaluator in the
+    process (utils/flightrec._DebugHandler, bench.py kill-path fetch)."""
+    with _GLOBAL_PHASES_LOCK:
+        return {k: {p: dict(v) for p, v in fam.items()}
+                for k, fam in _GLOBAL_PHASES.items()}
+
+
+def reset_global_dispatch_phases():
+    """Pristine module aggregate (tests)."""
+    with _GLOBAL_PHASES_LOCK:
+        _GLOBAL_PHASES.clear()
+
+
+#: thread-local batch attribution: the batch paths stamp how many
+#: queries shared the thread's last fused dispatch, the executor reads
+#: it back for strategy notes / SLOW QUERY `batch=` attribution.
+_BATCH_TLS = threading.local()
+
+
+def note_batch_size(n):
+    """Record the fused-batch size the current thread's query rode
+    (0 resets; 1 = solo dispatch)."""
+    _BATCH_TLS.size = int(n)
+
+
+def last_batch_size():
+    """Fused-batch size stamped by the last batched dispatch on THIS
+    thread (0 when the thread never rode one)."""
+    return getattr(_BATCH_TLS, "size", 0)
+
+
 _UNSET = object()
 
 from ..ops import bitplane  # noqa: E402
@@ -426,6 +483,10 @@ class StackedEvaluator:
         # tests assert these, not wall time (which is noisy on CPU).
         self.pairwise_dispatches = 0
         self.pairwise_syncs = 0
+        # Batched-pipeline observability (GET /debug/batching): fused
+        # launch_query_batch dispatches vs the queries that rode them.
+        self.batch_dispatches = 0
+        self.batched_queries = 0
 
     def _stack_sharding(self):
         """NamedSharding over all local devices (None on a single device),
@@ -1055,6 +1116,14 @@ class StackedEvaluator:
                     p = fam[phase] = {"count": 0, "seconds": 0.0}
                 p["count"] += 1
                 p["seconds"] += dt
+        # mirror into the process-wide aggregate: the bare debug server
+        # in bench children answers /debug/dispatch from it
+        with _GLOBAL_PHASES_LOCK:
+            gfam = _GLOBAL_PHASES.setdefault(kind, {})
+            for phase, dt in phases:
+                gp = gfam.setdefault(phase, {"count": 0, "seconds": 0.0})
+                gp["count"] += 1
+                gp["seconds"] += dt
         for phase, dt in phases:
             global_stats.timing("dispatch_phase_seconds", dt,
                                 {"kernel": kind, "phase": phase})
@@ -1180,13 +1249,22 @@ class StackedEvaluator:
         program per group (power-of-two bucket, padded by repeating the
         first query), fetches ALL results in one transfer, and
         distributes. Solo queries pay nothing extra; leader failures
-        propagate to every waiter (GroupCommit contract)."""
-        return self._count_commit.submit(
+        propagate to every waiter (GroupCommit contract).
+
+        The per-payload return is (count, fused-batch size); the size is
+        stamped into the waiter's thread-local here so SLOW QUERY lines
+        and strategy notes can attribute `batch=` without threading it
+        through every caller."""
+        count, size = self._count_commit.submit(
             (sig, tuple(stacks)), self._process_count_batch)
+        note_batch_size(size)
+        return count
 
     def _process_count_batch(self, payloads):
         """GroupCommit `process` for count queries: payloads are
-        (sig, stacks) pairs; returns their counts in order."""
+        (sig, stacks) pairs; returns (count, fused-batch size) pairs in
+        order — the size is how many REAL queries shared the payload's
+        dispatch (padding excluded)."""
         import jax
 
         groups = {}
@@ -1227,7 +1305,7 @@ class StackedEvaluator:
             his, los = np.atleast_1d(vals[i]), np.atleast_1d(vals[i + 1])
             i += 2
             for q, pos in enumerate(chunk):
-                results[pos] = combine_hi_lo(his[q], los[q])
+                results[pos] = (combine_hi_lo(his[q], los[q]), len(chunk))
         return results
 
     def _plane_fn(self, sig, arity):
@@ -1242,6 +1320,153 @@ class StackedEvaluator:
             return fn
 
         return self._get_fn(("plane", sig, arity), build)
+
+    # -- vmapped batch kernels (query coalescer) -----------------------------
+    #
+    # The coalescer's serving programs: `bucket` independent queries of
+    # one tree signature evaluated with a leading query axis. Args are
+    # bucket*arity separate [S, W] leaf stacks (query-major, exactly the
+    # device arrays the stack cache already holds — no host restacking);
+    # the program stacks each leaf slot to [B, S, W] and vmaps the tree
+    # combine over axis 0, so XLA fuses the whole batch into ONE launch
+    # and the 65ms dispatch RTT of BENCH r03 is paid once per batch.
+
+    def _vmap_count_fn(self, sig, arity, bucket):
+        """`bucket` count trees -> (hi [B], lo [B]) popcount totals."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            vtree = jax.vmap(lambda *stacks: self._tree_eval(sig, stacks))
+
+            @jax.jit
+            def fn(*flat):
+                # flat is query-major: flat[q*arity + j] = query q's leaf
+                # j, so flat[j::arity] gathers slot j across the batch
+                slots = [jnp.stack(flat[j::arity]) for j in range(arity)]
+                return bitplane.batch_popcount_hi_lo(vtree(*slots))
+
+            return fn
+
+        return self._get_fn(("countV", sig, arity, bucket), build)
+
+    def _vmap_plane_fn(self, sig, arity, bucket):
+        """`bucket` bitmap trees -> combined [B, S, W] plane stacks."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            vtree = jax.vmap(lambda *stacks: self._tree_eval(sig, stacks))
+
+            @jax.jit
+            def fn(*flat):
+                slots = [jnp.stack(flat[j::arity]) for j in range(arity)]
+                return vtree(*slots)
+
+            return fn
+
+        return self._get_fn(("planeV", sig, arity, bucket), build)
+
+    def gather_for_batch(self, idx, call, shards):
+        """Batch-member coverage + leaf-stack gather: (sig, stacks) or
+        None when the tree isn't batchable on the stacked path (caller
+        falls back to the per-query path)."""
+        shards = tuple(shards)
+        if len(shards) < MIN_SHARDS:
+            return None
+        return self._gather(idx, call, shards)
+
+    def launch_query_batch(self, items):
+        """Launch every gathered query in `items` — (kind, sig, stacks)
+        triples, kind "count" or "plane" — as bucket-padded vmapped
+        programs WITHOUT fetching anything back. Returns the opaque
+        handle resolve_query_batch() turns into per-item results with
+        ONE device->host transfer.
+
+        The split is the double buffer: the coalescer thread launches
+        batch N+1 (enqueue-only on accelerator backends) before
+        resolving batch N, overlapping batch N's host sync with batch
+        N+1's device execution. On the CPU test backend
+        _launch_barrier() serializes execution inside the lock, so the
+        overlap degenerates to FIFO — structurally identical, just
+        without the win."""
+        groups = {}
+        for pos, (kind, sig, stacks) in enumerate(items):
+            groups.setdefault((kind, sig, len(stacks)), []).append(pos)
+        launched = []
+        for (kind, sig, arity), positions in groups.items():
+            for i in range(0, len(positions), BATCH_BUCKETS[-1]):
+                chunk = positions[i:i + BATCH_BUCKETS[-1]]
+                bucket = batch_bucket(len(chunk))
+                args = []
+                for pos in chunk:
+                    args.extend(items[pos][2])
+                for _ in range(bucket - len(chunk)):
+                    args.extend(items[chunk[0]][2])  # pad: repeat q0
+                if kind == "count":
+                    fn = self._count_fn(sig, arity) if bucket == 1 \
+                        else self._vmap_count_fn(sig, arity, bucket)
+                    kname = "count_batched"
+                else:
+                    fn = self._plane_fn(sig, arity) if bucket == 1 \
+                        else self._vmap_plane_fn(sig, arity, bucket)
+                    kname = "plane_batched"
+                with self._lock:
+                    self.dispatches += 1
+                    self.batch_dispatches += 1
+                    self.batched_queries += len(chunk)
+                _flightrec.record("batch.dispatch", kernel=kname,
+                                  queries=len(chunk), bucket=bucket)
+                global_stats.count("batch_dispatch_total", 1, {
+                    "kernel": kname, "bucket": str(bucket)})
+                # batch-size histogram: occupancy per fused dispatch
+                global_stats.timing(
+                    "coalesce_batch_size", float(len(chunk)))
+                with self._locked_dispatch(
+                        kname,
+                        nbytes_in=sum(a.size for a in args) * 4,
+                        fn=fn) as ph:
+                    out = fn(*args)
+                    ph.mark("dispatch_ack")
+                    out = _launch_barrier(out)
+                    ph.mark("sync")
+                launched.append((kind, chunk, bucket, out))
+        return launched
+
+    def resolve_query_batch(self, launched):
+        """ONE device->host transfer for everything launch_query_batch
+        enqueued. Returns {item position: (result, fused-batch size)}:
+        count results are exact Python ints, plane results are host
+        [S_pad, W] uint32 arrays (row j = the j-th shard the stacks were
+        gathered over; padding rows are zero)."""
+        import jax
+
+        flat = []
+        for kind, _, _, out in launched:
+            if kind == "count":
+                flat.extend(out)  # (hi, lo)
+            else:
+                flat.append(out)
+        vals = jax.device_get(flat)
+        results = {}
+        i = 0
+        for kind, chunk, bucket, _ in launched:
+            if kind == "count":
+                # atleast_1d: the solo path returns 0-d scalars
+                his = np.atleast_1d(vals[i])
+                los = np.atleast_1d(vals[i + 1])
+                i += 2
+                for q, pos in enumerate(chunk):
+                    results[pos] = (combine_hi_lo(his[q], los[q]),
+                                    len(chunk))
+            else:
+                planes = vals[i]
+                i += 1
+                if bucket == 1:
+                    planes = planes[None]  # solo program: [S, W]
+                for q, pos in enumerate(chunk):
+                    results[pos] = (planes[q], len(chunk))
+        return results
 
     def _row_counts_fn(self, has_filt):
         """(rows [R,S,W], filt [S,W]?) -> (hi [R], lo [R]) counts of
@@ -1613,6 +1838,8 @@ class StackedEvaluator:
                 "group_fetched_queries": self._fetch_commit.batched,
                 "count_batches": self._count_commit.batches,
                 "count_batched_queries": self._count_commit.batched,
+                "batch_dispatches": self.batch_dispatches,
+                "batched_queries": self.batched_queries,
                 "stack_bytes": self._stack_bytes,
                 "stack_entries": len(self._stacks),
                 "rows_stack_bytes": self._rows_stack_bytes,

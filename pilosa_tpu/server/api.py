@@ -157,10 +157,164 @@ def result_to_json(result):
     raise ApiError(f"unencodable result type {type(result)!r}")
 
 
+class QueryCoalescer:
+    """Folds concurrent batchable queries into fused vmapped dispatches
+    (exec/stacked.launch_query_batch) so the per-dispatch RTT is paid
+    once per batch instead of once per query — BENCH_r03 measured
+    64.9ms of a 66.1ms p50 sitting in dispatch round-trip.
+
+    Lifecycle: HTTP handler threads submit() parsed single-call queries
+    and block on a per-query event; one lazy-started daemon drain thread
+    owns the pipeline. On an idle→busy transition it holds the batch
+    open for `window` seconds so batchmates arriving within the window
+    fuse; while the pipeline is busy the launch+resolve of the previous
+    batch IS the accumulation window (no extra sleep). The loop is
+    double-buffered: batch N+1 is launched (device enqueue via
+    Executor.launch_batch) BEFORE batch N's results are transferred
+    back (resolve_batch), so host sync of batch N overlaps device
+    execution of batch N+1.
+
+    Overload: a queue past `max_queue` rejects with 503 + Retry-After
+    (ServiceUnavailableError headers path) and counts
+    batch_rejected_total — never an unbounded wait."""
+
+    def __init__(self, api, window, max_queue=256):
+        self.api = api
+        self.window = float(window)
+        self.max_queue = int(max_queue)
+        self._cond = threading.Condition()
+        self._queue = []  # member dicts, FIFO
+        self._thread = None
+        self._closed = False
+        # observability (GET /debug/batching)
+        self.batches = 0            # fused launches issued
+        self.coalesced = 0          # queries that rode a fused launch
+        self.rejected = 0           # overload 503s
+        self.max_occupancy = 0      # largest single batch seen
+        self.batch_hist = {}        # occupancy -> count
+
+    def submit(self, index_name, query, pql):
+        """Enqueue one parsed batchable query and wait for its slot of
+        the fused result. Returns (results, batch_size, fingerprint);
+        re-raises the member's own error (per-query isolation — a
+        batchmate's failure is not ours)."""
+        from ..utils.stats import global_stats
+
+        m = {"index": index_name, "query": query, "pql": pql,
+             "event": threading.Event(), "t0": time.monotonic(),
+             "results": None, "error": None, "batch": 0, "fp": None}
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                global_stats.count("batch_rejected_total", 1)
+                raise ServiceUnavailableError(
+                    f"coalesce queue full ({self.max_queue}); shed load "
+                    "or raise --coalesce-max-queue", retry_after=1)
+            self._queue.append(m)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name="query-coalescer")
+                self._thread.start()
+            self._cond.notify()
+        m["event"].wait()
+        if m["error"] is not None:
+            raise m["error"]
+        return m["results"], m["batch"], m["fp"]
+
+    def stats(self):
+        with self._cond:
+            return {
+                "enabled": True,
+                "window_seconds": self.window,
+                "max_queue": self.max_queue,
+                "queue_depth": len(self._queue),
+                "batches": self.batches,
+                "coalesced_queries": self.coalesced,
+                "rejected": self.rejected,
+                "max_occupancy": self.max_occupancy,
+                "occupancy_hist": dict(sorted(self.batch_hist.items())),
+            }
+
+    def _pop_members(self):
+        """Drain everything queued right now (caller holds no lock)."""
+        with self._cond:
+            members, self._queue = self._queue, []
+            return members
+
+    def _drain_loop(self):
+        from ..utils import flightrec
+        from ..utils.stats import global_stats
+
+        ex = getattr(self.api.executor, "local", self.api.executor)
+        pending = []  # [(handle, state, members)] launched, unresolved
+        while not self._closed:
+            with self._cond:
+                while not self._queue and not pending:
+                    self._cond.wait()
+            was_idle = not pending
+            members = self._pop_members()
+            if members and was_idle and self.window > 0:
+                # idle→busy: hold the window open so concurrent
+                # arrivals fuse into this batch (busy pipelines get
+                # their window for free from the previous resolve)
+                time.sleep(self.window)
+                members += self._pop_members()
+            launched = []
+            for index_name, group in self._group(members).items():
+                now = time.monotonic()
+                for m in group:
+                    global_stats.timing(
+                        "coalesce_wait_seconds", now - m["t0"])
+                try:
+                    handle, state = ex.launch_batch(
+                        index_name, [m["query"] for m in group])
+                except Exception as exc:  # noqa: BLE001 — deliver, don't die
+                    for m in group:
+                        m["error"] = exc
+                        m["event"].set()
+                    continue
+                with self._cond:
+                    self.batches += 1
+                    self.coalesced += len(group)
+                    n = len(group)
+                    self.max_occupancy = max(self.max_occupancy, n)
+                    self.batch_hist[n] = self.batch_hist.get(n, 0) + 1
+                flightrec.record("batch.coalesce", index=index_name,
+                                 queries=len(group))
+                launched.append((handle, state, group))
+            # double buffer: batch N+1 is in flight; NOW sync batch N
+            for handle, state, group in pending:
+                self._resolve(ex, handle, state, group)
+            pending = launched
+
+    def _group(self, members):
+        by_index = {}
+        for m in members:
+            by_index.setdefault(m["index"], []).append(m)
+        return by_index
+
+    def _resolve(self, ex, handle, state, group):
+        try:
+            outs = ex.resolve_batch(handle, state)
+        except Exception as exc:  # noqa: BLE001 — deliver, don't die
+            for m in group:
+                m["error"] = exc
+                m["event"].set()
+            return
+        for m, (results, error, bsize, fp) in zip(group, outs):
+            m["results"] = results
+            m["error"] = error
+            m["batch"] = bsize
+            m["fp"] = fp
+            m["event"].set()
+
+
 class API:
     def __init__(self, holder, cluster=None, client_factory=None,
                  long_query_time=None, logger=None, spmd=None,
-                 max_writes_per_request=0, oplog=None):
+                 max_writes_per_request=0, oplog=None,
+                 coalesce_window=0.0, coalesce_max_queue=256):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
@@ -212,6 +366,17 @@ class API:
             self.executor = Executor(
                 holder, max_writes_per_request=max_writes_per_request)
             self.resize = None
+        # Query coalescer (batched dispatch pipeline): window 0 — the
+        # default — disables it entirely and keeps the legacy per-query
+        # path bit-identical. Cluster coordinators never coalesce; the
+        # fan-out legs are where the dispatches happen.
+        self.coalesce_window = float(coalesce_window or 0.0)
+        self.coalesce_max_queue = int(coalesce_max_queue)
+        if self.coalesce_window > 0 and cluster is None:
+            self._coalescer = QueryCoalescer(
+                self, self.coalesce_window, self.coalesce_max_queue)
+        else:
+            self._coalescer = None
         self._resize_writes = []  # queued (kind, kwargs) during RESIZING
         self._resize_writes_lock = threading.Lock()
         self._resize_draining = False  # replay thread active
@@ -538,6 +703,14 @@ class API:
             raise ServiceUnavailableError(
                 "device link DOWN (canary probes failing); "
                 f"retry in {retry:.0f}s", retry_after=retry)
+        # Coalescer routing: batchable single-call reads with default
+        # options fuse with concurrent arrivals into one vmapped
+        # dispatch. Ineligible queries (and window=0 deployments, where
+        # _coalescer is None) continue on the bit-identical legacy path.
+        if self._coalescer is not None:
+            routed = self._try_coalesce(index_name, pql, shards, options)
+            if routed is not None:
+                return routed[0]
         # Profile when the request asked (?profile=true) or a slow-query
         # threshold is configured (so a slow query's log line carries the
         # full span tree, not just its total). Remote fan-out legs never
@@ -585,6 +758,99 @@ class API:
             self._broadcast_shards_if_changed(index_name)
         return results
 
+    def _try_coalesce(self, index_name, pql, shards, options):
+        """Route one query through the coalescer when eligible. Returns
+        a 1-tuple (results,) on the coalesced path, or None to fall
+        through to the legacy per-query path (ineligible query — or a
+        parse error, which the legacy path re-raises with proper ApiError
+        wrapping)."""
+        from ..utils import flightrec
+        from ..utils import tracing
+        from ..utils import workload as workload_mod
+
+        if shards is not None or not isinstance(pql, str):
+            return None
+        o = options
+        if o is not None and (o.remote or o.profile or o.explain
+                              or o.column_attrs or o.exclude_columns
+                              or o.exclude_row_attrs
+                              or o.shards is not None):
+            return None
+        try:
+            query = parse(pql)
+        except Exception:
+            return None
+        call = query.calls[0] if len(query.calls) == 1 else None
+        if call is None or call.writes() \
+                or call.name not in self.executor.BATCHABLE_CALLS:
+            return None
+        t0 = time.monotonic()
+        wtoken = flightrec.watch_begin("query", index=index_name)
+        try:
+            # the span is the HTTP handler's whole wait: queue time +
+            # fused execution + demux (coalesce-wait observability)
+            with tracing.start_span("coalesce.wait", index=index_name):
+                results, bsize, fp = self._coalescer.submit(
+                    index_name, query, pql)
+        except (ApiError,):
+            raise
+        except Exception as e:
+            raise ApiError(str(e)) from e
+        finally:
+            flightrec.watch_end(wtoken)
+        # end_query ran on the coalescer thread, so THIS thread's
+        # last_fingerprint() is stale — pass the member's own through
+        self._log_slow_query(index_name, pql, time.monotonic() - t0,
+                             batch=bsize, fp=fp)
+        workload_mod.maybe_sample_slo()
+        return (results,)
+
+    def query_batch(self, index_name, pqls, shards=None):
+        """Execute a list of PQL queries as one batched dispatch (the
+        explicit POST /index/{i}/query-batch route, sharing the vmapped
+        executor path with the coalescer). Returns a list of
+        (results, error, batch_size, fingerprint) tuples in request
+        order — per-query error isolation, like the coalescer's."""
+        self._validate_state()
+        if self.holder.index(index_name) is None:
+            raise NotFoundError(f"index not found: {index_name}")
+        from ..utils import devhealth
+        if devhealth.is_down():
+            retry = devhealth.retry_after_seconds()
+            raise ServiceUnavailableError(
+                "device link DOWN (canary probes failing); "
+                f"retry in {retry:.0f}s", retry_after=retry)
+        if self.cluster is not None:
+            # cluster coordinators fan out per query; batching happens
+            # on the legs' own dispatch paths
+            out = []
+            for pql in pqls:
+                try:
+                    out.append((self.query(index_name, pql,
+                                           shards=shards), None, 0, None))
+                except Exception as exc:  # noqa: BLE001 — per-query
+                    out.append((None, exc, 0, None))
+            return out
+        return self.executor.execute_batch(
+            index_name, list(pqls), shards=shards)
+
+    def batching_stats(self):
+        """GET /debug/batching: coalescer occupancy/queue stats plus the
+        fused-dispatch counters from the stacked evaluator."""
+        if self._coalescer is not None:
+            co = self._coalescer.stats()
+        else:
+            co = {"enabled": False,
+                  "window_seconds": self.coalesce_window,
+                  "max_queue": self.coalesce_max_queue}
+        ex = getattr(self.executor, "local", self.executor)
+        st = ex.stacked_stats() if hasattr(ex, "stacked_stats") else {}
+        return {
+            "coalescer": co,
+            "batch_dispatches": st.get("batch_dispatches", 0),
+            "batched_queries": st.get("batched_queries", 0),
+        }
+
     def _broadcast_shards_if_changed(self, index_name):
         """Push this node's per-index available shards to peers when they
         changed (reference: availableShards gossiped via
@@ -629,11 +895,16 @@ class API:
                 out.append({"id": c, "attrs": attrs})
         return out
 
-    def _log_slow_query(self, index_name, pql, elapsed, prof=None):
+    def _log_slow_query(self, index_name, pql, elapsed, prof=None,
+                        batch=None, fp=None):
         """Slow-query log (reference: LongQueryTime api.go:1157). With a
         profile in hand the line carries the full span tree + counters as
         JSON, so the log alone answers dispatch-count vs lock-wait vs
-        kernel-time vs fan-out."""
+        kernel-time vs fan-out. batch= attributes the fused-dispatch
+        occupancy the query rode (1 = solo) so a query slowed by
+        coalesce-wait is distinguishable from one slowed by the kernel;
+        the coalesced path passes batch/fp explicitly because its
+        end_query ran on the coalescer thread, not this one."""
         if (self.long_query_time is not None
                 and elapsed > self.long_query_time):
             import json as _json
@@ -645,18 +916,24 @@ class API:
             # the executor just finished this query on THIS thread, so
             # its fingerprint is in take-last position — slow lines for
             # the same shape grep together across the fleet
-            fp = workload_mod.last_fingerprint() or "-"
+            if fp is None:
+                fp = workload_mod.last_fingerprint() or "-"
+            if batch is None:
+                from ..exec.stacked import last_batch_size
+                batch = last_batch_size()
+            batch = max(1, int(batch))
             flightrec.record("query.slow", index=index_name,
                              seconds=round(elapsed, 3), pql=q[:200],
-                             fingerprint=fp)
+                             fingerprint=fp, batch=batch)
             if prof is not None:
-                # trace=, plan=, and fingerprint= ride ahead of
+                # trace=, fingerprint=, batch=, and plan= ride ahead of
                 # profile=, which stays the LAST field: consumers parse
                 # the profile JSON as everything after "profile=" (tests
-                # pin this format). analyze queries stamp a full summary
-                # (with ! marking misestimated ops); otherwise derive
-                # one from whatever strategy notes the decision points
-                # emitted
+                # pin this format; they also pin plan= through " plan="/
+                # " profile=" splits, so batch= sits BEFORE plan=).
+                # analyze queries stamp a full summary (with ! marking
+                # misestimated ops); otherwise derive one from whatever
+                # strategy notes the decision points emitted
                 plan = prof.tag("plan_summary")
                 if not plan:
                     strategies = prof.tag("strategies")
@@ -665,13 +942,13 @@ class API:
                         for s in strategies) if strategies else "-"
                 self.logger.printf(
                     "%.03fs SLOW QUERY index=%s %s trace=%s fingerprint=%s "
-                    "plan=%s profile=%s", elapsed, index_name,
-                    q[:500], prof.root.trace_id, fp, plan,
+                    "batch=%d plan=%s profile=%s", elapsed, index_name,
+                    q[:500], prof.root.trace_id, fp, batch, plan,
                     _json.dumps(prof.to_dict()))
             else:
                 self.logger.printf(
-                    "%.03fs SLOW QUERY index=%s %s fingerprint=%s",
-                    elapsed, index_name, q[:500], fp)
+                    "%.03fs SLOW QUERY index=%s %s fingerprint=%s batch=%d",
+                    elapsed, index_name, q[:500], fp, batch)
 
     # -- schema DDL ---------------------------------------------------------
 

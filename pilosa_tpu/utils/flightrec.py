@@ -418,6 +418,15 @@ class _DebugHandler(http.server.BaseHTTPRequestHandler):
             body = json.dumps(_devhealth.snapshot(limit=8)).encode()
         elif path == "/debug/flightrecorder":
             body = json.dumps(snapshot()).encode()
+        elif path == "/debug/dispatch":
+            # process-wide dispatch-phase aggregate: which phase
+            # (lock_wait / transfer_in / compile / ack / sync) a wedged
+            # attempt's round trips were spending in — attached by
+            # bench.py to missed-deadline kill records
+            from ..exec.stacked import global_dispatch_phases
+
+            body = json.dumps(
+                {"phases": global_dispatch_phases()}).encode()
         else:
             self.send_error(404)
             return

@@ -114,10 +114,12 @@ class WorkloadTable:
         self.total_queries = 0
 
     def record(self, fp, shape, index, wall_seconds, deltas=None,
-               strategies=None, misestimates=0):
+               strategies=None, misestimates=0, batch=0):
         """Fold one finished query into its fingerprint's entry.
         `deltas` carries the per-query stacked-counter diffs
-        (dispatches, cache_hits, cache_misses, bytes_materialized)."""
+        (dispatches, cache_hits, cache_misses, bytes_materialized);
+        `batch` is the fused-batch size the query rode (0 or 1 = solo),
+        so the table answers which shapes actually coalesce."""
         deltas = deltas or {}
         with self._lock:
             self.total_queries += 1
@@ -130,6 +132,7 @@ class WorkloadTable:
                     "dispatches": 0, "cache_hits": 0, "cache_misses": 0,
                     "bytes_materialized": 0, "misestimates": 0,
                     "strategies": {},
+                    "batched_queries": 0, "batch_size_sum": 0,
                 }
                 while len(self._entries) > self.max_entries:
                     self._entries.popitem(last=False)
@@ -146,6 +149,9 @@ class WorkloadTable:
             e["misestimates"] += misestimates
             for s in strategies or ():
                 e["strategies"][s] = e["strategies"].get(s, 0) + 1
+            if batch > 1:
+                e["batched_queries"] += 1
+                e["batch_size_sum"] += int(batch)
             e["last_seen"] = time.time()
 
     def _render(self, e):
@@ -165,6 +171,10 @@ class WorkloadTable:
             "cache_hit_ratio": round(hits / (hits + misses), 4)
             if hits + misses else None,
             "strategies": dict(sorted(e["strategies"].items())),
+            "batched_queries": e["batched_queries"],
+            "avg_batch_size": round(
+                e["batch_size_sum"] / e["batched_queries"], 2)
+            if e["batched_queries"] else None,
             "misestimates": e["misestimates"],
             "misestimate_rate": round(e["misestimates"] / e["count"], 4),
             "idle_seconds": round(time.time() - e["last_seen"], 1),
@@ -599,7 +609,7 @@ def heat_bump(index, field, view, amount=1.0):
 
 class _QueryCtx:
     __slots__ = ("fingerprint", "shape", "index", "strategies",
-                 "misestimates")
+                 "misestimates", "batch")
 
     def __init__(self, fp, shape, index):
         self.fingerprint = fp
@@ -607,6 +617,7 @@ class _QueryCtx:
         self.index = index
         self.strategies = []
         self.misestimates = 0
+        self.batch = 0  # fused-batch size this query rode (0/1 = solo)
 
 
 def begin_query(index_name, query):
@@ -629,7 +640,16 @@ def end_query(ctx, wall_seconds, deltas=None):
     _local.last_fingerprint = ctx.fingerprint
     _table.record(ctx.fingerprint, ctx.shape, ctx.index, wall_seconds,
                   deltas=deltas, strategies=ctx.strategies,
-                  misestimates=ctx.misestimates)
+                  misestimates=ctx.misestimates, batch=ctx.batch)
+
+
+def abort_query(ctx):
+    """Discard an open context WITHOUT recording: a batch member that
+    falls back mid-gather re-enters through the per-query path, which
+    opens (and records) its own context — recording both would double
+    count the shape."""
+    if getattr(_local, "ctx", None) is ctx:
+        _local.ctx = None
 
 
 def note_strategy(op, strategy):
@@ -638,6 +658,14 @@ def note_strategy(op, strategy):
     ctx = getattr(_local, "ctx", None)
     if ctx is not None:
         ctx.strategies.append(f"{op}={strategy}")
+
+
+def note_batch(n):
+    """The batch paths report how many queries shared the in-flight
+    query's fused dispatch (workload-table batch attribution)."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is not None:
+        ctx.batch = max(ctx.batch, int(n))
 
 
 def note_misestimate():
