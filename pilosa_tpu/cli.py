@@ -463,6 +463,9 @@ def cmd_server(args):
         if monitor:
             monitor.stop()
         server.stop()
+        # AFTER server.stop(): in-flight handlers blocked on the
+        # coalescer wake with 503 instead of hanging the shutdown
+        api.close()
         holder.close()
         if oplog is not None:
             # AFTER holder.close(): fragments are synced and closed, so

@@ -463,6 +463,7 @@ class Executor:
         Returns (handle, state) for resolve_batch. Per-member failures
         are captured in the member's slot, never raised — one bad query
         must not sink its batchmates (per-query error isolation)."""
+        import copy
         import time as _time
 
         from ..utils import workload as workload_mod
@@ -475,21 +476,42 @@ class Executor:
         shard_list = self._call_shards(idx, shards)
         entries = []
         items = []
+        # Coalesced traffic repeats hot queries, so identical PQL
+        # strings in one batch share a single parsed (and translated)
+        # AST: members only ever read it past this loop. Translation is
+        # tracked per AST so a shared tree is key-translated exactly
+        # once — it mutates in place and is not idempotent.
+        parsed_cache = {}
+        translated = set()
         for query in queries:
-            e = {"query": query, "error": None, "item": None,
+            # e["raw"] is the member's UNTRANSLATED form: key translation
+            # mutates the call tree in place and is not idempotent (a
+            # keyed row arg becomes an int; re-translating raises), so
+            # every fallback re-execution — not-batchable shape, gather
+            # miss, fused-dispatch failure — must start from this, never
+            # from e["query"], which execute() would translate again.
+            e = {"query": query, "raw": query, "error": None, "item": None,
                  "fallback": False, "wctx": None, "deltas": None,
                  "call": None, "kind": None, "t0": _time.perf_counter()}
             entries.append(e)
             try:
                 if isinstance(query, str):
-                    query = e["query"] = parse(query)
+                    q = parsed_cache.get(query)
+                    if q is None:
+                        q = parsed_cache[query] = parse(query)
+                    query = e["query"] = q
                 check_write_limit(query, self.max_writes_per_request)
-                if not opt.remote:
-                    translate_calls(idx, query.calls)
                 call = query.calls[0] if len(query.calls) == 1 else None
                 if call is None or call.name not in self.BATCHABLE_CALLS:
+                    # left untranslated: execute() runs translation
                     e["fallback"] = True
                     continue
+                if not opt.remote:
+                    if not isinstance(e["raw"], str):
+                        e["raw"] = copy.deepcopy(query)
+                    if id(query) not in translated:
+                        translate_calls(idx, query.calls)
+                        translated.add(id(query))
                 if call.name == "Count":
                     if len(call.children) != 1:
                         raise ExecError(
@@ -515,9 +537,13 @@ class Executor:
                 wl_after = self._stacked.counters()
                 # gather-side deltas now, one dispatch at resolve: the
                 # fused launch serves the whole batch, so a per-member
-                # counter diff spanning it would bleed batchmates' work
+                # counter diff spanning it would bleed batchmates' work.
+                # dispatches stays 0 here — resolve_batch charges each
+                # fused dispatch to exactly ONE of the members that rode
+                # it, so per-shape dispatch counts don't inflate N× on
+                # the very path that exists to reduce them
                 e["deltas"] = {
-                    "dispatches": 1,
+                    "dispatches": 0,
                     "cache_hits": wl_after[1] - wl_before[1],
                     "cache_misses": wl_after[2] - wl_before[2],
                     "bytes_materialized":
@@ -559,6 +585,7 @@ class Executor:
         except Exception:  # noqa: BLE001 — degrade to per-query serving
             resolved = None
         out = []
+        charged = set()  # fused dispatches already attributed to a member
         for e in entries:
             query = e["query"]
             wctx = e["wctx"]
@@ -569,12 +596,18 @@ class Executor:
                 if e["fallback"] or resolved is None:
                     if wctx is not None:
                         workload_mod.abort_query(wctx)
+                    # re-execute from the untranslated form: e["query"]
+                    # may already be key-translated (see launch_batch),
+                    # and translation is not idempotent
                     results = self.execute(
-                        idx.name, query, shards=shards, options=opt)
+                        idx.name, e["raw"], shards=shards, options=opt)
                     out.append((results, None, 0,
                                 workload_mod.last_fingerprint()))
                     continue
-                val, bsize = resolved[e["item"]]
+                val, bsize, dseq = resolved[e["item"]]
+                if dseq not in charged:
+                    charged.add(dseq)
+                    e["deltas"]["dispatches"] = 1
                 if e["kind"] == "count":
                     results = [val]
                 else:

@@ -1435,10 +1435,13 @@ class StackedEvaluator:
 
     def resolve_query_batch(self, launched):
         """ONE device->host transfer for everything launch_query_batch
-        enqueued. Returns {item position: (result, fused-batch size)}:
-        count results are exact Python ints, plane results are host
-        [S_pad, W] uint32 arrays (row j = the j-th shard the stacks were
-        gathered over; padding rows are zero)."""
+        enqueued. Returns {item position: (result, fused-batch size,
+        dispatch index)}: count results are exact Python ints, plane
+        results are host [S_pad, W] uint32 arrays (row j = the j-th
+        shard the stacks were gathered over; padding rows are zero).
+        The dispatch index identifies which fused launch served the
+        item, so the caller can attribute each dispatch exactly once
+        across the members that rode it."""
         import jax
 
         flat = []
@@ -1450,7 +1453,7 @@ class StackedEvaluator:
         vals = jax.device_get(flat)
         results = {}
         i = 0
-        for kind, chunk, bucket, _ in launched:
+        for di, (kind, chunk, bucket, _) in enumerate(launched):
             if kind == "count":
                 # atleast_1d: the solo path returns 0-d scalars
                 his = np.atleast_1d(vals[i])
@@ -1458,14 +1461,14 @@ class StackedEvaluator:
                 i += 2
                 for q, pos in enumerate(chunk):
                     results[pos] = (combine_hi_lo(his[q], los[q]),
-                                    len(chunk))
+                                    len(chunk), di)
             else:
                 planes = vals[i]
                 i += 1
                 if bucket == 1:
                     planes = planes[None]  # solo program: [S, W]
                 for q, pos in enumerate(chunk):
-                    results[pos] = (planes[q], len(chunk))
+                    results[pos] = (planes[q], len(chunk), di)
         return results
 
     def _row_counts_fn(self, has_filt):

@@ -204,6 +204,9 @@ class QueryCoalescer:
              "event": threading.Event(), "t0": time.monotonic(),
              "results": None, "error": None, "batch": 0, "fp": None}
         with self._cond:
+            if self._closed:
+                raise ServiceUnavailableError(
+                    "query coalescer shut down", retry_after=1)
             if len(self._queue) >= self.max_queue:
                 self.rejected += 1
                 global_stats.count("batch_rejected_total", 1)
@@ -212,15 +215,60 @@ class QueryCoalescer:
                     "or raise --coalesce-max-queue", retry_after=1)
             self._queue.append(m)
             if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._drain_loop, daemon=True,
-                    name="query-coalescer")
-                self._thread.start()
+                self._start_thread_locked()
             self._cond.notify()
-        m["event"].wait()
+        # Bounded waits + a liveness check: the drain loop delivers
+        # every member's event even on internal errors (its whole body
+        # is exception-guarded), but if the thread is ever lost anyway,
+        # fail this handler fast instead of blocking it forever, and
+        # leave the coalescer usable for the next submit.
+        while not m["event"].wait(0.5):
+            with self._cond:
+                t = self._thread
+                if t is not None and t.is_alive():
+                    continue
+                if m in self._queue:
+                    self._queue.remove(m)
+                self._thread = None
+                if self._queue and not self._closed:
+                    self._start_thread_locked()
+            if not m["event"].is_set():
+                m["error"] = ServiceUnavailableError(
+                    "coalescer drain thread died; retry", retry_after=1)
+            break
         if m["error"] is not None:
             raise m["error"]
         return m["results"], m["batch"], m["fp"]
+
+    def _start_thread_locked(self):
+        self._thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="query-coalescer")
+        self._thread.start()
+
+    def close(self):
+        """Shut down the pipeline: wake the drain thread, deliver
+        in-flight batches, fail queued members with 503 so blocked
+        handler threads return instead of hanging past server shutdown,
+        and refuse new submits. Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._thread
+            self._cond.notify_all()
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        # no drain thread (never started, or already dead): fail the
+        # leftovers here; otherwise the loop's shutdown path did it
+        self._fail(self._pop_members(), ServiceUnavailableError(
+            "query coalescer shut down", retry_after=1))
+
+    @staticmethod
+    def _fail(members, exc):
+        for m in members:
+            if not m["event"].is_set():
+                m["error"] = exc
+                m["event"].set()
 
     def stats(self):
         with self._cond:
@@ -248,45 +296,68 @@ class QueryCoalescer:
 
         ex = getattr(self.api.executor, "local", self.api.executor)
         pending = []  # [(handle, state, members)] launched, unresolved
-        while not self._closed:
+        while True:
             with self._cond:
-                while not self._queue and not pending:
+                while not self._queue and not pending \
+                        and not self._closed:
                     self._cond.wait()
-            was_idle = not pending
-            members = self._pop_members()
-            if members and was_idle and self.window > 0:
-                # idle→busy: hold the window open so concurrent
-                # arrivals fuse into this batch (busy pipelines get
-                # their window for free from the previous resolve)
-                time.sleep(self.window)
-                members += self._pop_members()
+                if self._closed:
+                    break
+            # Everything below is exception-guarded: an error ANYWHERE
+            # in the iteration (stats, flightrec, grouping — not just
+            # the launch/resolve calls, which guard themselves) is
+            # delivered to every affected member and the loop keeps
+            # serving. An unguarded escape here used to kill the
+            # singleton thread and wedge all future submits forever.
+            members = []
             launched = []
-            for index_name, group in self._group(members).items():
-                now = time.monotonic()
-                for m in group:
-                    global_stats.timing(
-                        "coalesce_wait_seconds", now - m["t0"])
-                try:
-                    handle, state = ex.launch_batch(
-                        index_name, [m["query"] for m in group])
-                except Exception as exc:  # noqa: BLE001 — deliver, don't die
+            try:
+                was_idle = not pending
+                members = self._pop_members()
+                if members and was_idle and self.window > 0:
+                    # idle→busy: hold the window open so concurrent
+                    # arrivals fuse into this batch (busy pipelines get
+                    # their window for free from the previous resolve);
+                    # close() cuts the wait short
+                    with self._cond:
+                        self._cond.wait_for(lambda: self._closed,
+                                            timeout=self.window)
+                    members += self._pop_members()
+                for index_name, group in self._group(members).items():
+                    now = time.monotonic()
                     for m in group:
-                        m["error"] = exc
-                        m["event"].set()
-                    continue
-                with self._cond:
-                    self.batches += 1
-                    self.coalesced += len(group)
-                    n = len(group)
-                    self.max_occupancy = max(self.max_occupancy, n)
-                    self.batch_hist[n] = self.batch_hist.get(n, 0) + 1
-                flightrec.record("batch.coalesce", index=index_name,
-                                 queries=len(group))
-                launched.append((handle, state, group))
-            # double buffer: batch N+1 is in flight; NOW sync batch N
-            for handle, state, group in pending:
-                self._resolve(ex, handle, state, group)
-            pending = launched
+                        global_stats.timing(
+                            "coalesce_wait_seconds", now - m["t0"])
+                    try:
+                        handle, state = ex.launch_batch(
+                            index_name, [m["query"] for m in group])
+                    except Exception as exc:  # noqa: BLE001 — deliver
+                        self._fail(group, exc)
+                        continue
+                    with self._cond:
+                        self.batches += 1
+                        self.coalesced += len(group)
+                        n = len(group)
+                        self.max_occupancy = max(self.max_occupancy, n)
+                        self.batch_hist[n] = self.batch_hist.get(n, 0) + 1
+                    flightrec.record("batch.coalesce", index=index_name,
+                                     queries=len(group))
+                    launched.append((handle, state, group))
+                # double buffer: batch N+1 is in flight; NOW sync batch N
+                for handle, state, group in pending:
+                    self._resolve(ex, handle, state, group)
+                pending = launched
+            except Exception as exc:  # noqa: BLE001 — deliver, don't die
+                self._fail(members, exc)
+                for _, _, group in pending + launched:
+                    self._fail(group, exc)
+                pending = []
+        # closed: deliver in-flight batches (already launched — the
+        # results are real), then fail whatever is still queued
+        for handle, state, group in pending:
+            self._resolve(ex, handle, state, group)
+        self._fail(self._pop_members(), ServiceUnavailableError(
+            "query coalescer shut down", retry_after=1))
 
     def _group(self, members):
         by_index = {}
@@ -850,6 +921,14 @@ class API:
             "batch_dispatches": st.get("batch_dispatches", 0),
             "batched_queries": st.get("batched_queries", 0),
         }
+
+    def close(self):
+        """Release serving-side background state — currently the query
+        coalescer, whose blocked waiters get a 503 instead of hanging
+        on a daemon thread that dies with the process. Idempotent;
+        window=0 deployments (no coalescer) no-op."""
+        if self._coalescer is not None:
+            self._coalescer.close()
 
     def _broadcast_shards_if_changed(self, index_name):
         """Push this node's per-index available shards to peers when they

@@ -33,6 +33,7 @@ class ServerHarness:
 
     def close(self):
         self.server.stop()
+        self.api.close()
         self.holder.close()
 
 

@@ -13,6 +13,7 @@ and the plan-layer `batched` annotation.
 
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -134,6 +135,50 @@ def test_batch_error_isolation(env):
         assert res[0] == ex.execute("i", queries[i])[0]
 
 
+def test_batch_fallback_keyed_not_double_translated(env):
+    """Fallback members re-execute from their UNTRANSLATED form. Key
+    translation mutates the call tree in place and is not idempotent
+    (the second pass sees an int where it demands a string key), so a
+    batch member that falls back — non-batchable shape, or batchable
+    but gather-missed on a single-shard index (< MIN_SHARDS) — must
+    not be translated twice."""
+    from pilosa_tpu.core.field import FieldOptions
+
+    holder, api, ex = env
+    api.create_index("kd")
+    api.create_field("kd", "kf", FieldOptions(keys=True))
+    api.query("kd", 'Set(7, kf="abc")')
+    api.query("kd", 'Set(9, kf="abc")')
+    # one shard only: Count(Row(kf="abc")) classifies batchable, gets
+    # translated, then gather-misses (MIN_SHARDS) and falls back; TopN
+    # exercises the never-batchable fallback on the same keyed field
+    out = ex.execute_batch("kd", ['Count(Row(kf="abc"))', "TopN(kf)"])
+    assert out[0][1] is None, out[0][1]
+    assert out[1][1] is None, out[1][1]
+    assert out[0][0] == ex.execute("kd", 'Count(Row(kf="abc"))')
+    assert out[0][0] == [2]
+
+
+def test_fused_dispatch_charged_once_in_workload(env):
+    """N members riding ONE fused dispatch record 1 dispatch total in
+    the workload table, not N — the path built to reduce dispatches
+    must not inflate its own per-shape dispatch counts."""
+    from pilosa_tpu.utils import workload as workload_mod
+
+    holder, api, ex = env
+    api.create_index("wk")
+    api.create_field("wk", "f")
+    cols = [s * SHARD_WIDTH + 3 for s in range(N_SHARDS)]
+    api.import_bits("wk", "f", [0] * len(cols), cols)
+    out = ex.execute_batch("wk", ["Count(Row(f=0))"] * 4)
+    assert all(err is None for _, err, _, _ in out)
+    assert {bsize for _, _, bsize, _ in out} == {4}
+    snap = workload_mod.table().snapshot(top=100)
+    mine = [e for e in snap["by_frequency"] if e["index"] == "wk"]
+    assert mine
+    assert sum(e["dispatches"] for e in mine) == 1
+
+
 def test_batch_dispatch_flightrec_events(env):
     """Fused launches leave batch.dispatch events in the flight
     recorder (kernel family + occupancy + padded bucket)."""
@@ -227,6 +272,70 @@ def test_coalescer_overload_rejects_503(env):
     assert ei.value.status == 503
     assert ei.value.headers and "Retry-After" in ei.value.headers
     assert capi._coalescer.stats()["rejected"] == 1
+
+
+def test_coalescer_survives_drain_loop_errors(env, monkeypatch):
+    """An exception outside the guarded launch/resolve calls (here:
+    flightrec.record, part of the loop's observability plumbing) is
+    delivered to the waiting members — not left to kill the singleton
+    drain thread, which would wedge every future submit forever — and
+    the thread keeps serving subsequent queries."""
+    from pilosa_tpu.utils import flightrec
+
+    holder, api, ex = env
+    capi = API(holder, coalesce_window=0.001)
+    real, armed = flightrec.record, [True]
+
+    def bad_record(kind, **tags):
+        if armed[0] and kind == "batch.coalesce":
+            armed[0] = False
+            raise RuntimeError("observability exploded")
+        return real(kind, **tags)
+
+    monkeypatch.setattr(flightrec, "record", bad_record)
+    with pytest.raises(ApiError, match="observability exploded"):
+        capi.query("i", "Count(Row(f=1))")
+    # same coalescer, same thread: the next query is served normally
+    assert capi.query("i", "Count(Row(f=1))") == \
+        api.query("i", "Count(Row(f=1))")
+    capi.close()
+
+
+def test_coalescer_close_unblocks_waiters(env):
+    """close() never leaves a submit() hanging: queued members are
+    delivered (results if their batch launched, 503 otherwise), new
+    submits are refused with 503, and close is idempotent. API.close()
+    on a window=0 deployment (no coalescer) is a no-op."""
+    holder, api, ex = env
+    api.close()  # window=0: must not raise
+    capi = API(holder, coalesce_window=30.0)  # park members in-window
+    done = []
+
+    def worker():
+        try:
+            done.append(("ok", capi.query("i", "Count(Row(f=1))")))
+        except Exception as e:  # noqa: BLE001 — surfaced via done
+            done.append(("err", e))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline \
+            and capi._coalescer._thread is None and not done:
+        time.sleep(0.002)
+    time.sleep(0.02)  # let the drain thread pop into its window wait
+    capi.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "close() left a waiter hanging"
+    assert done
+    kind, val = done[0]
+    if kind == "ok":  # batch launched before close: real results
+        assert val == api.query("i", "Count(Row(f=1))")
+    else:
+        assert isinstance(val, ApiError)
+    with pytest.raises(ServiceUnavailableError):
+        capi._coalescer.submit("i", None, "Count(Row(f=1))")
+    capi.close()  # idempotent
 
 
 # ------------------------------------------------------------ HTTP layer
