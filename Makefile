@@ -15,10 +15,11 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
-	test-workload test-batching lint bench-cpu
+	test-workload test-batching test-containers lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
-	test-explain test-durability test-workload test-batching
+	test-explain test-durability test-workload test-batching \
+	test-containers
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -75,6 +76,13 @@ test-parallel:
 	$(PY) -m pytest tests/test_workpool.py \
 		tests/test_workpool_differential.py \
 		tests/test_workpool_serving.py $(PYTEST_FLAGS)
+
+# Compressed container surface: representation builders/kernels, the
+# per-fragment chooser, the differential corpus (compressed == dense
+# bit-identity across densities, reprs, and batch buckets), and the
+# /debug compression surfaces.
+test-containers:
+	$(PY) -m pytest tests/test_containers.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

@@ -460,6 +460,42 @@ def _last_record(out_lines):
     return None
 
 
+#: classifications that mean "the device tunnel wedged under us" —
+#: transient by nature, so the orchestrator grants ONE bonus retry
+_TUNNEL_WEDGES = ("tunnel_down", "tunnel_init_hang", "dispatch_wedge")
+
+
+def _classify_wedge(phase, tail, dev):
+    """Classify a killed attempt from the forensics it already carries,
+    so BENCH_r{N}.json says WHAT wedged instead of shrugging:
+
+    - tunnel_down      — the child's own canary prober marked the device
+                         link DOWN (/debug/device state)
+    - dispatch_wedge   — the flight-recorder tail shows a dispatch.start
+                         with no matching dispatch.end: a kernel round
+                         trip entered the tunnel and never came back
+    - tunnel_init_hang — killed before the probe marker with no open
+                         dispatch: backend init (jax.devices()) hung
+    - unclassified     — none of the signatures match (real code bug,
+                         plain timeout, forensics unreachable)
+
+    Pure function of the already-fetched snapshots — no I/O."""
+    if (dev or {}).get("state") == "DOWN":
+        return "tunnel_down"
+    open_dispatch = 0
+    for evt in (tail or {}).get("events") or []:
+        kind = evt.get("kind")
+        if kind == "dispatch.start":
+            open_dispatch += 1
+        elif kind == "dispatch.end":
+            open_dispatch = max(0, open_dispatch - 1)
+    if open_dispatch > 0:
+        return "dispatch_wedge"
+    if phase == "probe":
+        return "tunnel_init_hang"
+    return "unclassified"
+
+
 def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
     """Spawn one child attempt; return its parsed JSON record or None.
 
@@ -582,6 +618,7 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
                    "error": f"bench child killed: {reason}{detail}"}
         rec.setdefault("error", f"bench child killed: {reason}")
         rec["phase"] = phase
+        rec["wedge_classification"] = _classify_wedge(phase, tail, dev)
         if tail is not None:
             rec["flightrec"] = tail
         if disp is not None:
@@ -669,11 +706,13 @@ def orchestrate() -> None:
     last_err = None
     attempts_made = 0
     attempt_log = []  # per-attempt forensics for the final error record
-    for attempt in range(attempts):
+    max_attempts = attempts
+    wedge_retry_granted = False
+    while attempts_made < max_attempts:
         remaining = budget - (time.perf_counter() - t0)
         if remaining < 30:
             break
-        print(f"bench: attempt {attempt + 1}/{attempts}, "
+        print(f"bench: attempt {attempts_made + 1}/{max_attempts}, "
               f"{remaining:.0f}s budget left", file=sys.stderr, flush=True)
         attempts_made += 1
         rec = _run_attempt(remaining, probe)
@@ -687,7 +726,29 @@ def orchestrate() -> None:
                 "attempt": attempts_made,
                 "phase": rec.get("phase"),
                 "reason": rec.get("error"),
+                "wedge_classification": rec.get("wedge_classification"),
             })
+            # When the LAST budgeted attempt dies on a classified
+            # tunnel wedge not seen in any earlier attempt, grant
+            # exactly one bonus attempt: a fresh tunnel wedge is
+            # transient by nature (the link died, not the code), and
+            # one wedged tunnel shouldn't zero a whole BENCH round. A
+            # wedge that already reproduced with the same
+            # classification is systematic, and unclassified failures
+            # are likely real bugs: neither gets a bonus — retries
+            # there just burn budget.
+            wc = rec.get("wedge_classification")
+            seen_before = any(
+                a.get("wedge_classification") == wc
+                for a in attempt_log[:-1])
+            if (wc in _TUNNEL_WEDGES and not wedge_retry_granted
+                    and attempts_made == max_attempts
+                    and not seen_before):
+                wedge_retry_granted = True
+                max_attempts += 1
+                print(f"bench: classified tunnel wedge ({wc}); "
+                      "granting one bonus retry", file=sys.stderr,
+                      flush=True)
         else:
             attempt_log.append({"attempt": attempts_made, "phase": None,
                                 "reason": "no JSON record from child"})

@@ -309,6 +309,16 @@ def cmd_server(args):
             coalesce_window=coalesce_window if coalesce_window > 0
             else None)
 
+    # Container representation policy (ops/containers.py module state):
+    # "auto" lets the per-fragment chooser pick dense/sparse/rle by
+    # measured density; forcing "dense" is the bit-identical escape
+    # hatch. Validated here so a typo fails startup, not first query.
+    crepr = config.get("container-repr")
+    if crepr is not None:
+        from .ops import containers as _containers
+
+        _containers.configure(str(crepr))
+
     # SLO objectives: error-budget burn rate over the existing timing
     # histograms (utils/workload.py module state). Accepts a repeated
     # --slo flag (list) or a comma-separated string from the config file.
@@ -785,7 +795,8 @@ def _apply_server_flags(config, args):
                  "plan_ring_size", "explain_misestimate_factor",
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
-                 "coalesce_window", "coalesce_max_queue"):
+                 "coalesce_window", "coalesce_max_queue",
+                 "container_repr"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -990,6 +1001,13 @@ def main(argv=None):
                         "one vmapped batched dispatch, amortizing the "
                         "dispatch RTT (default 0 = disabled, legacy "
                         "per-query path)")
+    p.add_argument("--container-repr", default=None,
+                   choices=["auto", "dense", "sparse", "rle"],
+                   help="device container representation policy: auto "
+                        "(default) picks dense/block-sparse/run-length "
+                        "per fragment by measured density; dense forces "
+                        "the legacy bit-identical planes; sparse/rle "
+                        "force one compressed format where eligible")
     p.add_argument("--coalesce-max-queue", type=int, default=None,
                    help="coalesce queue cap: past it, queries get 503 + "
                         "Retry-After instead of unbounded wait "
@@ -1102,6 +1120,8 @@ def main(argv=None):
     p.add_argument("--slo-burn-threshold", type=float, default=None)
     p.add_argument("--coalesce-window", default=None)
     p.add_argument("--coalesce-max-queue", type=int, default=None)
+    p.add_argument("--container-repr", default=None,
+                   choices=["auto", "dense", "sparse", "rle"])
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"])
     p.add_argument("--no-oplog", action="store_true", default=False)

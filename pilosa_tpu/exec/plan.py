@@ -455,6 +455,13 @@ class Planner:
         node.annotations["cache"] = self._cache_state(probe)
         node.annotations["leaves"] = probe["leaves"]
         node.annotations["resident_leaves"] = probe["resident"]
+        # per-leaf container representation ("repr: dense|sparse|rle"
+        # with leaf counts) + the compressed-bytes estimate the chooser
+        # committed to — resident containers report exact bytes, cold
+        # leaves fall back to the fragment ledger's last build
+        rc = probe.get("repr_counts")
+        if rc:
+            node.annotations["repr"] = dict(rc)
         node.estimate["bytes_materialized"] = \
             node.estimate.get("bytes_materialized", 0) \
             + probe["missing_bytes"]
@@ -525,8 +532,15 @@ class Planner:
             kernels = {"count": 1}
             node.estimate["dispatches"] = \
                 1 + self._merge_extras(kernels, probe)
-            node.estimate["bytes_touched"] = \
+            # bytes_touched prices what the count kernel actually reads
+            # (compressed container bytes); dense_bytes_touched is the
+            # plane-scan baseline the chooser competed against — analyze
+            # compares the two to catch repr-misestimates
+            dense_bytes = \
                 probe["leaves"] * self._plane_bytes(tuple(shard_list))
+            node.estimate["bytes_touched"] = \
+                probe.get("compressed_bytes", dense_bytes)
+            node.estimate["dense_bytes_touched"] = dense_bytes
             self.cost.price(node, kernels)
         else:
             node.strategy = "per-shard"
@@ -898,15 +912,23 @@ def graft_actual(node, wall_seconds, before, after, kernel_before,
         * WORDS_PER_ROW * 4,
     }
     k_wall = 0.0
+    k_bytes = 0
     k_by_family = {}
     for family, k in kernel_after.items():
         prev = kernel_before.get(family, {"count": 0, "seconds": 0.0})
         dn = k["count"] - prev["count"]
         ds = k["seconds"] - prev["seconds"]
+        db = k.get("bytes_in", 0) - prev.get("bytes_in", 0)
         if dn > 0:
             k_by_family[family] = dn
             k_wall += ds
+            if db > 0:
+                k_bytes += db
     actual["kernel_wall_seconds"] = round(k_wall, 6)
+    # bytes the dispatched kernels actually read (compressed container
+    # bytes under --container-repr auto, dense plane bytes otherwise) —
+    # the analyze-side ground truth for the repr-misestimate check
+    actual["bytes_touched"] = k_bytes
     if k_by_family:
         actual["kernels"] = k_by_family
     if phases_before is not None and phases_after is not None:
@@ -966,6 +988,18 @@ def flag_misestimates(node, factor=None):
         if dev > factor:
             flags.append({"metric": metric, "estimated": est,
                           "actual": act, "deviation": round(dev, 2)})
+    # repr-misestimate: the chooser committed to a compressed
+    # representation, but the kernels read MORE bytes than the dense
+    # plane scan would have — the choice made the query worse. Rides
+    # the same ring/counter as the cost misestimates.
+    dense_est = node.estimate.get("dense_bytes_touched")
+    act_bytes = node.actual.get("bytes_touched", 0)
+    reprs = node.annotations.get("repr") or {}
+    if (dense_est and act_bytes > dense_est
+            and any(k != "dense" for k in reprs)):
+        flags.append({"metric": "container_repr",
+                      "estimated": dense_est, "actual": act_bytes,
+                      "deviation": round(act_bytes / dense_est, 2)})
     node.misestimates = flags
     if flags:
         _count_misestimate(node.op)

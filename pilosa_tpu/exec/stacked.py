@@ -305,6 +305,7 @@ def last_batch_size():
 _UNSET = object()
 
 from ..ops import bitplane  # noqa: E402
+from ..ops import containers as _containers  # noqa: E402
 from ..ops.bitplane import combine_hi_lo  # noqa: E402  (canonical helper)
 
 
@@ -531,6 +532,20 @@ class StackedEvaluator:
         return jax.device_put(host_stack, jax.sharding.NamedSharding(
             sharding.mesh, jax.sharding.PartitionSpec(*spec)))
 
+    def _place_replicated(self, host_array):
+        """Upload a compressed container component replicated across the
+        mesh: compressed arrays have no shard axis to partition, and an
+        explicitly replicated operand keeps the serving program a valid
+        GSPMD launch next to mesh-sharded dense stacks (XLA reshards as
+        needed). On a single device this is a plain device_put."""
+        import jax
+
+        sharding = self._stack_sharding()
+        if sharding is None:
+            return jax.device_put(host_array)
+        return jax.device_put(host_array, jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec()))
+
     # -- tree analysis -------------------------------------------------------
 
     def _leaf(self, idx, field_name, row_id, leaves):
@@ -659,26 +674,29 @@ class StackedEvaluator:
         _workload.heat_bump(*self._heat_key(key))
         return hit
 
-    def _ledger_key(self, key):
+    def _ledger_key(self, key, repr_kind):
         """Every cache key carries (kind, index, field, ...) at positions
-        0-2; the ledger attributes bytes per (index, field, pool)."""
+        0-2; the ledger attributes bytes per (index, field, pool, repr) —
+        the repr dimension is what makes /debug/hbm answer "how much of
+        the residency is compressed" (rows/BSI pools are always dense)."""
         pool_name = "rows" if key[0] == "rows" else "stack"
-        return (key[1], key[2], pool_name)
+        return (key[1], key[2], pool_name, repr_kind)
 
-    def _ledger_add(self, key, delta):
+    def _ledger_add(self, key, delta, repr_kind="dense"):
         """Move the HBM ledger in lockstep with the pool byte counters
         (caller holds self._lock). Gauges update here too: puts/evicts
         are cache-fill events, not per-query hot path."""
-        lkey = self._ledger_key(key)
+        lkey = self._ledger_key(key, repr_kind)
         new = self._hbm_ledger.get(lkey, 0) + delta
         if new <= 0:
             self._hbm_ledger.pop(lkey, None)
             new = 0
         else:
             self._hbm_ledger[lkey] = new
-        index, field, pool_name = lkey
+        index, field, pool_name, repr_kind = lkey
         global_stats.gauge("hbm_stack_bytes", new, {
-            "index": index, "field": field, "pool": pool_name})
+            "index": index, "field": field, "pool": pool_name,
+            "repr": repr_kind})
 
     def _count_eviction(self, pool_name, cause, n=1):
         """Per-pool, cause-tagged eviction counters (caller holds
@@ -692,6 +710,7 @@ class StackedEvaluator:
         pool, budget = self._pool(key)
         rows = pool is self._rows_stacks
         pool_name = "rows" if rows else "stack"
+        repr_kind = _containers.kind_of(arrays)
         evicted_keys = []
         with self._lock:
             old = pool.pop(key, None)
@@ -700,16 +719,18 @@ class StackedEvaluator:
                     self._rows_stack_bytes -= old[2]
                 else:
                     self._stack_bytes -= old[2]
-                self._ledger_add(key, -old[2])
+                self._ledger_add(key, -old[2],
+                                 _containers.kind_of(old[1]))
             pool[key] = [gens, arrays, nbytes, stamp, time.time()]
-            self._ledger_add(key, nbytes)
+            self._ledger_add(key, nbytes, repr_kind)
             if rows:
                 self._rows_stack_bytes += nbytes
                 while self._rows_stack_bytes > budget and len(pool) > 1:
                     ekey, evicted = pool.popitem(last=False)
                     self._rows_stack_bytes -= evicted[2]
                     self.evictions += 1
-                    self._ledger_add(ekey, -evicted[2])
+                    self._ledger_add(ekey, -evicted[2],
+                                     _containers.kind_of(evicted[1]))
                     self._count_eviction(pool_name, "budget")
                     evicted_keys.append((ekey, evicted[2]))
             else:
@@ -718,17 +739,22 @@ class StackedEvaluator:
                     ekey, evicted = pool.popitem(last=False)
                     self._stack_bytes -= evicted[2]
                     self.evictions += 1
-                    self._ledger_add(ekey, -evicted[2])
+                    self._ledger_add(ekey, -evicted[2],
+                                     _containers.kind_of(evicted[1]))
                     self._count_eviction(pool_name, "budget")
                     evicted_keys.append((ekey, evicted[2]))
         _flightrec.record("cache.put", pool=pool_name, index=key[1],
-                          field=key[2], bytes=nbytes)
+                          field=key[2], bytes=nbytes, repr=repr_kind)
         for ekey, ebytes in evicted_keys:
             _flightrec.record("cache.evict", pool=pool_name, index=ekey[1],
                               field=ekey[2], bytes=ebytes, cause="budget")
 
     def leaf_stack(self, idx, field_name, row_id, shards):
-        """Cached [S, W] device stack of one row over `shards`."""
+        """Cached Container of one row's [S, W] plane stack over
+        `shards` — the per-fragment representation chooser's call site:
+        a cold build analyzes the host stack's measured density and
+        picks dense / block-sparse / run-length per the configured
+        --container-repr mode (ops/containers.choose)."""
         key = ("leaf", idx.name, field_name, row_id, shards)
         field = idx.field(field_name)
         view = field.view(VIEW_STANDARD) if field is not None else None
@@ -749,7 +775,13 @@ class StackedEvaluator:
         # those planes and scatter them into the cached device stack —
         # the device analog of the reference's op-log-over-snapshot delta
         # (roaring.go:228-249) — instead of re-uploading the whole [S, W]
-        # stack for a single set_bit.
+        # stack for a single set_bit. A compressed container has no
+        # per-shard planes to scatter into, so it decompresses ON
+        # DEVICE once and the fragment decays to dense under write
+        # churn — the same convert-on-mutation policy as the
+        # reference's roaring containers; the chooser re-compresses at
+        # the next full rebuild/readmission, when the density is known
+        # again.
         stale = self._stale_entry(key, gens)
         if stale is not None:
             changed = self._changed_shards(stale[0], gens, shards)
@@ -759,16 +791,30 @@ class StackedEvaluator:
                 block = self._host_rows(
                     view, [row_id], [shards[j] for j in changed],
                     pad=False)
+                ent = stale[1]
+                if isinstance(ent, _containers.Container) \
+                        and ent.kind != "dense":
+                    old = _containers.to_dense(
+                        (ent.kind, ent.arrays, ent.shape[0]))
+                elif isinstance(ent, _containers.Container):
+                    old = ent.arrays[0]
+                else:
+                    old = ent
                 stack = self._place(
-                    stale[1].at[np.asarray(changed)].set(
+                    old.at[np.asarray(changed)].set(
                         jnp.asarray(block[0])), shard_axis=0)
                 self.patches += 1
-                self._cache_put(key, gens, stack, stack.size * 4, stamp)
-                return stack
+                cont = _containers.dense_container(stack)
+                self._cache_put(key, gens, cont, cont.nbytes, stamp)
+                return cont
         host = self._host_rows(view, [row_id], shards)
-        stack = self._place(host[0], shard_axis=0)
-        self._cache_put(key, gens, stack, stack.size * 4, stamp)
-        return stack
+        cont = _containers.build(
+            host[0],
+            place_sharded=lambda a: self._place(a, shard_axis=0),
+            place_replicated=self._place_replicated,
+            fragment=(idx.name, field_name, VIEW_STANDARD, row_id))
+        self._cache_put(key, gens, cont, cont.nbytes, stamp)
+        return cont
 
     def _host_rows(self, view, row_ids, shards, pad=True):
         """Host [R, S_padded, W] uint32 gather of rows over shards
@@ -1191,51 +1237,55 @@ class StackedEvaluator:
                 acc = acc & ~p
         return acc
 
-    def _count_fn(self, sig, arity):
-        """Tree -> (hi, lo) int32 popcount totals over all shards."""
+    def _count_fn(self, sig, csig):
+        """Tree -> (hi, lo) int32 popcount totals over all shards.
+        `csig` is the tuple of container signatures (or a legacy arity
+        int meaning that many raw dense stacks — test/back-compat call
+        sites). The program itself lives in ops/containers.count_program:
+        all-dense signatures trace to EXACTLY the legacy tree-eval +
+        popcount program (to_dense is the identity), which is the
+        forced-dense bit-identity guarantee."""
         import jax
-        import jax.numpy as jnp
+
+        csig = _containers.norm_csig(csig)
 
         def build():
             @jax.jit
-            def fn(*stacks):
-                acc = self._tree_eval(sig, stacks)
-                per_shard = jnp.sum(
-                    jax.lax.population_count(acc).astype(jnp.int32),
-                    axis=-1)
-                return bitplane.hi_lo(per_shard)
+            def fn(*flat):
+                return _containers.count_program(
+                    sig, csig, flat, self._tree_eval)
 
             return fn
 
-        return self._get_fn(("count", sig, arity), build)
+        return self._get_fn(("count", sig, csig), build)
 
-    def _count_batch_fn(self, sig, arity, batch):
+    def _count_batch_fn(self, sig, csig, batch):
         """`batch` independent count trees of one signature fused into ONE
-        program: args are batch*arity leaf stacks, outputs are [batch]
-        (hi, lo) vectors. This is bench.py's batched-serving trick
-        productionized (VERDICT r3 item 5): one dispatch + one fetch
+        program: args are batch*flat_arity container components, outputs
+        are [batch] (hi, lo) vectors. This is bench.py's batched-serving
+        trick productionized (VERDICT r3 item 5): one dispatch + one fetch
         amortize the per-query round trip across every concurrent query."""
         import jax
         import jax.numpy as jnp
 
+        csig = _containers.norm_csig(csig)
+        af = _containers.flat_arity(csig)
+
         def build():
             @jax.jit
-            def fn(*all_stacks):
+            def fn(*all_flat):
                 his, los = [], []
                 for q in range(batch):
-                    stacks = all_stacks[q * arity:(q + 1) * arity]
-                    acc = self._tree_eval(sig, stacks)
-                    per_shard = jnp.sum(
-                        jax.lax.population_count(acc).astype(jnp.int32),
-                        axis=-1)
-                    hi, lo = bitplane.hi_lo(per_shard)
+                    flat = all_flat[q * af:(q + 1) * af]
+                    hi, lo = _containers.count_program(
+                        sig, csig, flat, self._tree_eval)
                     his.append(hi)
                     los.append(lo)
                 return jnp.stack(his), jnp.stack(los)
 
             return fn
 
-        return self._get_fn(("countB", sig, arity, batch), build)
+        return self._get_fn(("countB", sig, csig, batch), build)
 
     #: count-batcher buckets: batch sizes are rounded up to a power of two
     #: (padding repeats the first query) so at most log2(MAX) programs
@@ -1269,9 +1319,10 @@ class StackedEvaluator:
 
         groups = {}
         for pos, (sig, stacks) in enumerate(payloads):
-            groups.setdefault((sig, len(stacks)), []).append(pos)
+            csig = tuple(c.csig for c in stacks)
+            groups.setdefault((sig, csig), []).append(pos)
         outs = []
-        for (sig_g, arity), positions in groups.items():
+        for (sig_g, csig_g), positions in groups.items():
             for i in range(0, len(positions), self.MAX_COUNT_BATCH):
                 chunk = positions[i:i + self.MAX_COUNT_BATCH]
                 size = 1 << (len(chunk) - 1).bit_length()
@@ -1279,18 +1330,21 @@ class StackedEvaluator:
                     # solo query: reuse the plain count program (shared
                     # with warm pre-batching traffic) instead of
                     # compiling an identical batch-1 variant
-                    fn = self._count_fn(sig_g, arity)
+                    fn = self._count_fn(sig_g, csig_g)
                 else:
-                    fn = self._count_batch_fn(sig_g, arity, size)
+                    fn = self._count_batch_fn(sig_g, csig_g, size)
                 args = []
+                nbytes_in = 0
                 for pos in chunk:
-                    args.extend(payloads[pos][1])
+                    args.extend(_containers.flatten(payloads[pos][1]))
+                    nbytes_in += sum(c.nbytes for c in payloads[pos][1])
                 for _ in range(size - len(chunk)):
-                    args.extend(payloads[chunk[0]][1])  # pad: repeat q0
+                    args.extend(  # pad: repeat q0
+                        _containers.flatten(payloads[chunk[0]][1]))
+                    nbytes_in += sum(
+                        c.nbytes for c in payloads[chunk[0]][1])
                 with self._locked_dispatch(
-                        "count",
-                        nbytes_in=sum(a.size for a in args) * 4,
-                        fn=fn) as ph:
+                        "count", nbytes_in=nbytes_in, fn=fn) as ph:
                     his, los = fn(*args)
                     ph.mark("dispatch_ack")
                     _launch_barrier((his, los))
@@ -1308,18 +1362,24 @@ class StackedEvaluator:
                 results[pos] = (combine_hi_lo(his[q], los[q]), len(chunk))
         return results
 
-    def _plane_fn(self, sig, arity):
-        """Tree -> combined [S, W] plane stack (filter materialization)."""
+    def _plane_fn(self, sig, csig):
+        """Tree -> combined [S, W] plane stack (filter materialization).
+        Compressed leaves decompress in-program (exact by construction)
+        so the output is always the legacy dense plane; `csig` accepts a
+        legacy arity int for raw dense args (time_union fold)."""
         import jax
+
+        csig = _containers.norm_csig(csig)
 
         def build():
             @jax.jit
-            def fn(*stacks):
-                return self._tree_eval(sig, stacks)
+            def fn(*flat):
+                return _containers.plane_program(
+                    sig, csig, flat, self._tree_eval)
 
             return fn
 
-        return self._get_fn(("plane", sig, arity), build)
+        return self._get_fn(("plane", sig, csig), build)
 
     # -- vmapped batch kernels (query coalescer) -----------------------------
     #
@@ -1331,41 +1391,54 @@ class StackedEvaluator:
     # combine over axis 0, so XLA fuses the whole batch into ONE launch
     # and the 65ms dispatch RTT of BENCH r03 is paid once per batch.
 
-    def _vmap_count_fn(self, sig, arity, bucket):
-        """`bucket` count trees -> (hi [B], lo [B]) popcount totals."""
+    def _vmap_count_fn(self, sig, csig, bucket):
+        """`bucket` count trees -> (hi [B], lo [B]) popcount totals.
+        Queries in one vmapped bucket share a container signature AND
+        exact component shapes (launch_query_batch groups on gsig), so
+        each flat component slot stacks to a leading batch axis and the
+        per-query compressed count program vmaps over it."""
         import jax
         import jax.numpy as jnp
 
+        csig = _containers.norm_csig(csig)
+        af = _containers.flat_arity(csig)
+
         def build():
-            vtree = jax.vmap(lambda *stacks: self._tree_eval(sig, stacks))
+            vprog = jax.vmap(lambda *flat: _containers.count_program(
+                sig, csig, flat, self._tree_eval))
 
             @jax.jit
             def fn(*flat):
-                # flat is query-major: flat[q*arity + j] = query q's leaf
-                # j, so flat[j::arity] gathers slot j across the batch
-                slots = [jnp.stack(flat[j::arity]) for j in range(arity)]
-                return bitplane.batch_popcount_hi_lo(vtree(*slots))
+                # flat is query-major: flat[q*af + j] = query q's j-th
+                # component, so flat[j::af] gathers slot j across the
+                # batch
+                slots = [jnp.stack(flat[j::af]) for j in range(af)]
+                return vprog(*slots)
 
             return fn
 
-        return self._get_fn(("countV", sig, arity, bucket), build)
+        return self._get_fn(("countV", sig, csig, bucket), build)
 
-    def _vmap_plane_fn(self, sig, arity, bucket):
+    def _vmap_plane_fn(self, sig, csig, bucket):
         """`bucket` bitmap trees -> combined [B, S, W] plane stacks."""
         import jax
         import jax.numpy as jnp
 
+        csig = _containers.norm_csig(csig)
+        af = _containers.flat_arity(csig)
+
         def build():
-            vtree = jax.vmap(lambda *stacks: self._tree_eval(sig, stacks))
+            vprog = jax.vmap(lambda *flat: _containers.plane_program(
+                sig, csig, flat, self._tree_eval))
 
             @jax.jit
             def fn(*flat):
-                slots = [jnp.stack(flat[j::arity]) for j in range(arity)]
-                return vtree(*slots)
+                slots = [jnp.stack(flat[j::af]) for j in range(af)]
+                return vprog(*slots)
 
             return fn
 
-        return self._get_fn(("planeV", sig, arity, bucket), build)
+        return self._get_fn(("planeV", sig, csig, bucket), build)
 
     def gather_for_batch(self, idx, call, shards):
         """Batch-member coverage + leaf-stack gather: (sig, stacks) or
@@ -1392,24 +1465,37 @@ class StackedEvaluator:
         without the win."""
         groups = {}
         for pos, (kind, sig, stacks) in enumerate(items):
-            groups.setdefault((kind, sig, len(stacks)), []).append(pos)
+            # group on gsig (repr kinds + exact component shapes):
+            # same-representation fragments keep fusing into one vmapped
+            # bucket exactly as before, while a mixed-repr batch SPLITS
+            # into per-representation groups — each degrades to its own
+            # (possibly solo) dispatch on the legacy program shape
+            # instead of failing the batch
+            gsig = tuple(c.gsig for c in stacks)
+            groups.setdefault((kind, sig, gsig), []).append(pos)
         launched = []
-        for (kind, sig, arity), positions in groups.items():
+        for (kind, sig, _gsig), positions in groups.items():
+            csig = tuple(c.csig for c in items[positions[0]][2])
             for i in range(0, len(positions), BATCH_BUCKETS[-1]):
                 chunk = positions[i:i + BATCH_BUCKETS[-1]]
                 bucket = batch_bucket(len(chunk))
                 args = []
+                nbytes_in = 0
                 for pos in chunk:
-                    args.extend(items[pos][2])
+                    args.extend(_containers.flatten(items[pos][2]))
+                    nbytes_in += sum(c.nbytes for c in items[pos][2])
                 for _ in range(bucket - len(chunk)):
-                    args.extend(items[chunk[0]][2])  # pad: repeat q0
+                    args.extend(  # pad: repeat q0
+                        _containers.flatten(items[chunk[0]][2]))
+                    nbytes_in += sum(
+                        c.nbytes for c in items[chunk[0]][2])
                 if kind == "count":
-                    fn = self._count_fn(sig, arity) if bucket == 1 \
-                        else self._vmap_count_fn(sig, arity, bucket)
+                    fn = self._count_fn(sig, csig) if bucket == 1 \
+                        else self._vmap_count_fn(sig, csig, bucket)
                     kname = "count_batched"
                 else:
-                    fn = self._plane_fn(sig, arity) if bucket == 1 \
-                        else self._vmap_plane_fn(sig, arity, bucket)
+                    fn = self._plane_fn(sig, csig) if bucket == 1 \
+                        else self._vmap_plane_fn(sig, csig, bucket)
                     kname = "plane_batched"
                 with self._lock:
                     self.dispatches += 1
@@ -1423,9 +1509,7 @@ class StackedEvaluator:
                 global_stats.timing(
                     "coalesce_batch_size", float(len(chunk)))
                 with self._locked_dispatch(
-                        kname,
-                        nbytes_in=sum(a.size for a in args) * 4,
-                        fn=fn) as ph:
+                        kname, nbytes_in=nbytes_in, fn=fn) as ph:
                     out = fn(*args)
                     ph.mark("dispatch_ack")
                     out = _launch_barrier(out)
@@ -1582,13 +1666,20 @@ class StackedEvaluator:
         stacks = []
         for key, _ in ordered:
             if key[0] == "bsicond":
-                stacks.append(self.bsi_condition_stack(idx, key, shards))
+                s = self.bsi_condition_stack(idx, key, shards)
             elif key[0] == "timerow":
-                stacks.append(self.time_row_stack(idx, key, shards))
+                s = self.time_row_stack(idx, key, shards)
             else:
                 _, field_name, row_id = key
+                # leaf_stack returns a Container already
                 stacks.append(
                     self.leaf_stack(idx, field_name, row_id, shards))
+                continue
+            # bsi-condition masks / time-union folds are freshly computed
+            # dense planes: wrap without copying so downstream programs
+            # see one uniform container argument shape
+            stacks.append(
+                None if s is None else _containers.dense_container(s))
         if any(s is None for s in stacks):
             return None
         return sig, stacks
@@ -1621,12 +1712,13 @@ class StackedEvaluator:
             return False, None
         sig, stacks = gathered
         self.dispatches += 1
-        fn = self._plane_fn(sig, len(stacks))
+        fn = self._plane_fn(sig, tuple(c.csig for c in stacks))
+        plane_bytes = stacks[0].shape[0] * stacks[0].shape[1] * 4
         with self._locked_dispatch(
                 "filter",
-                nbytes_in=sum(s.size for s in stacks) * 4,
-                nbytes_out=stacks[0].size * 4, fn=fn) as ph:
-            out = fn(*stacks)
+                nbytes_in=sum(c.nbytes for c in stacks),
+                nbytes_out=plane_bytes, fn=fn) as ph:
+            out = fn(*_containers.flatten(stacks))
             ph.mark("dispatch_ack")
             out = _launch_barrier(out)
             ph.mark("sync")
@@ -1861,9 +1953,10 @@ class StackedEvaluator:
             self._rows_stacks.clear()
             self._rows_stack_bytes = 0
             # zero (don't drop) the gauges: a scraper must see the flush
-            for (index, field, pool_name) in list(self._hbm_ledger):
+            for (index, field, pool_name, repr_kind) in list(self._hbm_ledger):
                 global_stats.gauge("hbm_stack_bytes", 0, {
-                    "index": index, "field": field, "pool": pool_name})
+                    "index": index, "field": field, "pool": pool_name,
+                    "repr": repr_kind})
             self._hbm_ledger.clear()
             if n_stack:
                 self._count_eviction("stack", "invalidate", n_stack)
@@ -1888,18 +1981,37 @@ class StackedEvaluator:
             for pool_name, pool in (("stack", self._stacks),
                                     ("rows", self._rows_stacks)):
                 for key, entry in pool.items():
-                    entries.append({
+                    e = {
                         "pool": pool_name,
                         "kind": key[0],
                         "index": key[1],
                         "field": key[2],
                         "bytes": entry[2],
+                        "repr": _containers.kind_of(entry[1]),
                         "last_hit_age_seconds": round(now - entry[4], 3),
                         "key": repr(key),
-                    })
+                    }
+                    if isinstance(entry[1], _containers.Container):
+                        ratio = entry[1].meta.get("ratio")
+                        if ratio is not None:
+                            e["compression_ratio"] = ratio
+                    entries.append(e)
+            # aggregate the repr-keyed ledger back to (index, field,
+            # pool) for by_index_field consumers (the /debug/heat join
+            # keys on index+field), and expose the repr split + the
+            # per-representation totals alongside
+            agg = {}
+            by_repr = {}
+            for (i, f, p, r), b in self._hbm_ledger.items():
+                agg[(i, f, p)] = agg.get((i, f, p), 0) + b
+                by_repr[r] = by_repr.get(r, 0) + b
             by_index_field = [
                 {"index": i, "field": f, "pool": p, "bytes": b}
                 for (i, f, p), b in sorted(
+                    agg.items(), key=lambda kv: -kv[1])]
+            by_index_field_repr = [
+                {"index": i, "field": f, "pool": p, "repr": r, "bytes": b}
+                for (i, f, p, r), b in sorted(
                     self._hbm_ledger.items(), key=lambda kv: -kv[1])]
             snap = {
                 "total_bytes": self._stack_bytes + self._rows_stack_bytes,
@@ -1910,6 +2022,9 @@ class StackedEvaluator:
                 "rows_stack_entries": len(self._rows_stacks),
                 "rows_stack_budget_bytes": MAX_ROWS_STACK_BYTES,
                 "by_index_field": by_index_field,
+                "by_index_field_repr": by_index_field_repr,
+                "by_repr": by_repr,
+                "container_fragments": _containers.fragment_ledger(),
                 "evictions": {
                     f"{p}.{c}": n
                     for (p, c), n in sorted(self.pool_evictions.items())},
@@ -2016,31 +2131,40 @@ class StackedEvaluator:
     # contract for ?explain=true is a dispatch-counter delta of zero.
 
     def _probe(self, key, idx, field_name, view_name):
-        """Presence + freshness of one pool entry with NO side effects.
-        Mirrors _cache_get_fast/_cache_get validation (view stamp first,
-        per-shard generation walk second) but never touches LRU order,
-        last-hit stamps, or the hit/miss counters — a plan must not
-        distort the telemetry it is trying to explain."""
+        """Presence + freshness of one pool entry with NO side effects
+        (see _probe_entry)."""
+        return self._probe_entry(key, idx, field_name, view_name)[0]
+
+    def _probe_entry(self, key, idx, field_name, view_name):
+        """(resident, resident_bytes, repr) of one pool entry with NO
+        side effects. Mirrors _cache_get_fast/_cache_get validation
+        (view stamp first, per-shard generation walk second) but never
+        touches LRU order, last-hit stamps, or the hit/miss counters —
+        a plan must not distort the telemetry it is trying to explain.
+        bytes/repr are the RESIDENT entry's (compressed container bytes
+        for compressed leaf stacks); (0, "dense") when absent."""
         field = idx.field(field_name)
         view = field.view(view_name) if field is not None else None
         if view is None:
-            return False
+            return False, 0, "dense"
         pool, _ = self._pool(key)
         with self._lock:
             hit = pool.get(key)
             if hit is None:
-                return False
+                return False, 0, "dense"
             if hit[3] == (view.uid, view.mutations):
-                return True
+                return True, hit[2], _containers.kind_of(hit[1])
         # stamp drifted: fall back to the exact generation walk (done
         # outside the pool lock — it touches fragment containers)
         gens = self._fragment_gens(idx, field_name, key[-1], view_name,
                                    view=view)
         if gens is None:
-            return False
+            return False, 0, "dense"
         with self._lock:
             hit = pool.get(key)
-            return hit is not None and hit[0] == gens
+            if hit is not None and hit[0] == gens:
+                return True, hit[2], _containers.kind_of(hit[1])
+            return False, 0, "dense"
 
     def rows_chunk_resident(self, idx, field_name, row_chunk, shards,
                             view_name=VIEW_STANDARD):
@@ -2073,7 +2197,8 @@ class StackedEvaluator:
         shards = tuple(shards)
         out = {"covered": False, "leaves": 0, "resident": 0,
                "resident_bytes": 0, "missing_bytes": 0,
-               "extra_kernels": {}}
+               "extra_kernels": {}, "repr_counts": {},
+               "compressed_bytes": 0}
         leaves = {}
         sig = self.signature(idx, call, leaves)
         if sig is None or not leaves:
@@ -2082,6 +2207,14 @@ class StackedEvaluator:
         out["leaves"] = len(leaves)
         plane = self._padded_len(shards) * WORDS_PER_ROW * 4
         for key in leaves:
+            # per-leaf representation + compressed-bytes estimate for
+            # the cost model: actual container bytes when resident, the
+            # fragment ledger's last-build record when not (the chooser
+            # is deterministic in the data, so the last build predicts
+            # the next), dense otherwise. resident/missing_bytes keep
+            # their dense meaning — they price the HOST gather a cold
+            # build pays, which is dense either way.
+            ckind, cbytes = "dense", None
             if key[0] == "bsicond":
                 resident, nbytes = self._probe_bsicond(idx, key, shards,
                                                        plane, out)
@@ -2091,9 +2224,20 @@ class StackedEvaluator:
             else:
                 _, field_name, row_id = key
                 leaf_key = ("leaf", idx.name, field_name, row_id, shards)
-                resident = self._probe(leaf_key, idx, field_name,
-                                       VIEW_STANDARD)
+                resident, ebytes, ekind = self._probe_entry(
+                    leaf_key, idx, field_name, VIEW_STANDARD)
                 nbytes = plane
+                if resident:
+                    ckind, cbytes = ekind, ebytes
+                else:
+                    est = _containers.fragment_estimate(
+                        idx.name, field_name, VIEW_STANDARD, row_id)
+                    if est is not None:
+                        ckind, cbytes = est["repr"], est["bytes"]
+            rc = out["repr_counts"]
+            rc[ckind] = rc.get(ckind, 0) + 1
+            out["compressed_bytes"] += cbytes if cbytes is not None \
+                else nbytes
             if resident:
                 out["resident"] += 1
                 out["resident_bytes"] += nbytes

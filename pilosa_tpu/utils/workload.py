@@ -293,7 +293,7 @@ class HeatLedger:
             k = (r["index"], r["field"])
             resident[k] = resident.get(k, 0) + r["bytes"]
         hot_not_resident = sorted(
-            ({"index": i, "field": f, "heat": round(h, 4)}
+            (self._price_admission(i, f, h)
              for (i, f), h in heat_by_if.items()
              if h >= HEAT_HOT_MIN and (i, f) not in resident),
             key=lambda e: -e["heat"])
@@ -315,6 +315,28 @@ class HeatLedger:
             "resident_but_cold": resident_cold[:top],
             "resident_but_cold_total": len(resident_cold),
         }
+
+    @staticmethod
+    def _price_admission(index, field, heat):
+        """One hot_but_not_resident candidate, priced by what admission
+        would ACTUALLY cost in HBM: the container ledger's compressed
+        bytes from the fragment's last build (the chooser is
+        deterministic in the data, so the last build predicts the
+        next). Fragments never built carry no estimate — the candidate
+        still lists, unpriced."""
+        e = {"index": index, "field": field, "heat": round(heat, 4)}
+        try:
+            from ..ops import containers
+
+            est = containers.field_estimate(index, field)
+        except Exception:  # pragma: no cover - observability only
+            est = None
+        if est is not None:
+            e["est_bytes"] = est["bytes"]
+            e["est_dense_bytes"] = est["dense_bytes"]
+            e["compression_ratio"] = est["ratio"]
+            e["reprs"] = est["reprs"]
+        return e
 
     def _export_gauges(self, hottest):
         """fragment_heat gauges for the current top-N; keys that fell

@@ -182,10 +182,12 @@ def test_watchdog_rejects_bad_deadline():
 # ----------------------------------------------------- HBM ledger exactness
 
 def _ledger_pool_sums(ev):
+    from pilosa_tpu.ops import containers
+
     sums = {}
     for pool_name, pool in (("stack", ev._stacks), ("rows", ev._rows_stacks)):
         for key, entry in pool.items():
-            lkey = (key[1], key[2], pool_name)
+            lkey = (key[1], key[2], pool_name, containers.kind_of(entry[1]))
             sums[lkey] = sums.get(lkey, 0) + entry[2]
     return sums
 
@@ -283,7 +285,7 @@ def test_replace_updates_ledger_without_eviction_count():
     ev._cache_put(key, ("g2",), object(), 300)  # replacement
     assert ev.evictions == 0
     assert ev._stack_bytes == 300
-    assert ev._hbm_ledger[("i", "f", "stack")] == 300
+    assert ev._hbm_ledger[("i", "f", "stack", "dense")] == 300
 
 
 # ------------------------------------------------- kernel attribution

@@ -133,6 +133,6 @@ def test_stacks_sharded_over_devices(setup):
     ex = Executor(holder)
     assert ex.execute("st", "Count(Row(f=1))")[0] > 0
     entry, = list(ex._stacked._stacks.values())
-    stack = entry[1]
+    stack = entry[1].arrays[0]  # dense container: (plane stack,)
     assert len(stack.sharding.device_set) == len(jax.devices())
     assert stack.shape[0] % len(jax.devices()) == 0  # zero-padded
