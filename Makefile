@@ -15,11 +15,12 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
-	test-workload test-batching test-containers lint bench-cpu
+	test-workload test-batching test-containers test-adaptive lint \
+	bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
-	test-containers
+	test-containers test-adaptive
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -83,6 +84,12 @@ test-parallel:
 # /debug compression surfaces.
 test-containers:
 	$(PY) -m pytest tests/test_containers.py $(PYTEST_FLAGS)
+
+# Adaptive execution surface: cost-model strategy/tile decisions, the
+# heat×cost cache policy, proactive admission, shadow-mode A/B, the
+# on==off differential corpus, and /debug/optimizer.
+test-adaptive:
+	$(PY) -m pytest tests/test_adaptive.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

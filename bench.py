@@ -83,6 +83,17 @@ def _fsync_mode():
         return None
 
 
+def _adaptive_tag():
+    """(mode, decision counters) of the adaptive engine for attempt
+    tagging — in-process, the bench drives the executor directly."""
+    try:
+        from pilosa_tpu.exec import adaptive
+
+        return adaptive.mode(), adaptive.decision_counts()
+    except Exception:
+        return None, None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -250,6 +261,7 @@ def main():
     served_qps = served.get("served_qps", 0.0) \
         if served.get("n_shards") == n_shards else 0.0
     best_qps = max(qps, served_qps)
+    adaptive_mode, adaptive_decisions = _adaptive_tag()
     print(json.dumps({
         "metric": f"pql_intersect_count_qps_{n_columns // 1_000_000}M_cols",
         "value": round(best_qps, 2),
@@ -290,6 +302,11 @@ def main():
             # continuous canary prober roll-up (state machine + last
             # RTT) — present when the orchestrator child started one
             "device_link": _device_link_tag(),
+            # adaptive engine mode + decision counters: a regression
+            # hunt must know whether (and how) the optimizer was
+            # steering the run it is comparing against
+            "adaptive_mode": adaptive_mode,
+            "adaptive_decisions": adaptive_decisions,
         },
     }))
 

@@ -1663,6 +1663,156 @@ def bench_compression():
         "bit_identical": True})
 
 
+def bench_adaptive():
+    """Adaptive execution acceptance leg (ISSUE 13).
+
+    Three claims, one JSON line:
+    1. Under a constrained HBM budget and a hot/cold mixed workload,
+       heat×cost benefit caching (--adaptive on) retains >=1.2x the
+       stack-cache hits of pure LRU (off) — the cold one-off stream can
+       no longer strip the hot working set's residency.
+    2. The pairwise tile the engine auto-tunes from its per-tile EWMA
+       samples lands within 10% of the best statically swept tile.
+    3. The shadow/on decision path (price both strategies, pick one)
+       costs <2% of a warm query's wall — adaptivity is observability-
+       priced, not a new tax.
+    """
+    from pilosa_tpu.exec import Executor as Executor_cls
+    from pilosa_tpu.exec import adaptive
+    from pilosa_tpu.exec import stacked as stacked_mod
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import workload
+
+    platform, holder, api, ex0 = _env()
+    n_shards = 2
+    n_cold = 16
+    api.create_index("adp")
+    idx = holder.index("adp")
+    rng = np.random.default_rng(23)
+
+    def fill(field_name, rows):
+        api.create_field("adp", field_name)
+        cols, row_ids = [], []
+        for row in rows:
+            for shard in range(n_shards):
+                c = rng.choice(SHARD_WIDTH, size=50, replace=False)
+                cols.append(shard * SHARD_WIDTH + c)
+                row_ids.append(np.full(len(c), row))
+        idx.field(field_name).import_bits(
+            np.concatenate(row_ids).astype(np.uint64),
+            np.concatenate(cols).astype(np.uint64))
+
+    fill("hot", range(4))
+    for j in range(n_cold):
+        fill(f"cold{j}", [0])
+
+    prev_budget = stacked_mod.MAX_STACK_BYTES
+    # one probe build sizes the budget: room for the 4-row hot working
+    # set plus 2 streaming entries — the cold burst (8/round) must not
+    # fit alongside it, or LRU would never be forced to choose
+    ex0.execute("adp", "Count(Row(hot=0))")
+    entry_bytes = ex0._stacked._stack_bytes
+    budget = entry_bytes * 6
+    rounds = 6
+
+    def run_policy(mode):
+        """(cache_hits, warm_hot_query_ms) for one eviction policy over
+        the identical hot/cold trace (fresh executor + heat ledger)."""
+        adaptive.reset()
+        workload.reset()
+        adaptive.configure(mode=mode)
+        if mode != "off":
+            # pin the strategy surface: this claim isolates CACHE
+            # policy, so every query must stay on the stacked path
+            adaptive.observe_fallback("Count", 1000.0, 1)
+        ex = Executor_cls(holder)
+        stacked_mod.MAX_STACK_BYTES = budget
+        st = ex._stacked
+        hot_ms = None
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            for row in range(4):
+                ex.execute("adp", f"Count(Row(hot={row}))")
+            hot_ms = (time.perf_counter() - t0) / 4 * 1000
+            for j in range(8):
+                ex.execute("adp", f"Count(Row(cold{(r * 8 + j) % n_cold}=0))")
+        stacked_mod.MAX_STACK_BYTES = prev_budget
+        return st.hits, hot_ms
+
+    lru_hits, _ = run_policy("off")
+    on_hits, hot_warm_ms = run_policy("on")
+    on_counts = adaptive.decision_counts()
+    hit_ratio = on_hits / max(1, lru_hits)
+    assert hit_ratio >= 1.2, (
+        f"benefit caching only reached {on_hits} hits vs LRU's "
+        f"{lru_hits} ({hit_ratio:.2f}x, gate 1.2x) — heat is not "
+        "protecting the hot working set")
+
+    # --- tile auto-tune: sweep static tiles, then let the engine pick
+    fill("ga", range(12))
+    fill("gb", range(10))
+    st = ex0._stacked
+    shards = tuple(sorted(idx.available_shards()))
+    a_rows, b_rows = list(range(12)), list(range(10))
+    adaptive.reset()
+    adaptive.configure(mode="on")
+    chunk = st.row_chunk_size(shards)
+    candidates = sorted({max(1, chunk >> s) for s in range(4)})
+    sweep = {}
+    for t in candidates:
+        st.pairwise_counts(idx, "ga", a_rows, "gb", b_rows, None,
+                           shards, tile=t)  # build + compile at t
+        t0 = time.perf_counter()
+        for _ in range(3):
+            st.pairwise_counts(idx, "ga", a_rows, "gb", b_rows, None,
+                               shards, tile=t)
+        sweep[t] = (time.perf_counter() - t0) / 3 * 1000
+    dec = adaptive.decide_tile(chunk, len(a_rows), len(b_rows))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        st.pairwise_counts(idx, "ga", a_rows, "gb", b_rows, None,
+                           shards, tile=dec.tile)
+    tuned_ms = (time.perf_counter() - t0) / 3 * 1000
+    best_ms = min(sweep.values())
+    assert tuned_ms <= best_ms * 1.10, (
+        f"auto-tuned tile {dec.tile} ran {tuned_ms:.2f}ms vs best "
+        f"static {best_ms:.2f}ms (gate 10%): {sweep}")
+
+    # --- decision-path overhead: the per-query work shadow/on add is
+    # one residency-priced decide_strategy; microbench it against the
+    # warm hot-query wall measured above
+    adaptive.configure(mode="shadow")
+    n_probe = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        adaptive.decide_strategy("Count", {"count": 1}, n_shards,
+                                 stacked=st)
+    decide_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = decide_ns / 1e6 / hot_warm_ms * 100
+    assert overhead_pct < 2.0, (
+        f"decision path costs {overhead_pct:.3f}% of a warm query wall "
+        "(gate 2%) — shadow mode is no longer a free A/B harness")
+
+    adaptive.reset()
+    workload.reset()
+    stacked_mod.MAX_STACK_BYTES = prev_budget
+    _close(holder)
+    _emit("adaptive_cache_hit_ratio", hit_ratio, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "adaptive_mode": "on",
+        "hits_benefit": on_hits, "hits_lru": lru_hits,
+        "budget_entries": 6, "rounds": rounds,
+        "hot_query_warm_ms": round(hot_warm_ms, 3),
+        "tile_sweep_ms": {str(t): round(ms, 3)
+                          for t, ms in sweep.items()},
+        "tile_chosen": dec.tile,
+        "tile_tuned_ms": round(tuned_ms, 3),
+        "tile_best_static_ms": round(best_ms, 3),
+        "decide_ns": round(decide_ns, 1),
+        "decide_overhead_pct": round(overhead_pct, 4),
+        "adaptive_decisions": on_counts})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -1678,6 +1828,7 @@ CONFIGS = {
     "workload_overhead": bench_workload_overhead,
     "batching_qps": bench_batching_qps,
     "compression": bench_compression,
+    "adaptive": bench_adaptive,
 }
 
 

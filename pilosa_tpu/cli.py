@@ -319,6 +319,17 @@ def cmd_server(args):
 
         _containers.configure(str(crepr))
 
+    # Adaptive execution engine (exec/adaptive.py module state): "on"
+    # closes the cost-model/heat loop into strategy, tiling, and cache
+    # policy; "shadow" computes-and-logs decisions without acting; the
+    # default "off" keeps every legacy path byte-for-byte. Validated
+    # here so a typo fails startup, not first query.
+    amode = config.get("adaptive")
+    if amode is not None:
+        from .exec import adaptive as _adaptive
+
+        _adaptive.configure(mode=str(amode))
+
     # SLO objectives: error-budget burn rate over the existing timing
     # histograms (utils/workload.py module state). Accepts a repeated
     # --slo flag (list) or a comma-separated string from the config file.
@@ -796,7 +807,7 @@ def _apply_server_flags(config, args):
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
                  "coalesce_window", "coalesce_max_queue",
-                 "container_repr"):
+                 "container_repr", "adaptive"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -1012,6 +1023,14 @@ def main(argv=None):
                    help="coalesce queue cap: past it, queries get 503 + "
                         "Retry-After instead of unbounded wait "
                         "(default 256)")
+    p.add_argument("--adaptive", default=None,
+                   choices=["off", "on", "shadow"],
+                   help="adaptive execution engine: on prices "
+                        "stacked-vs-fallback, GroupBy tile shape, and "
+                        "cache admission/eviction through the calibrated "
+                        "cost model + fragment heat; shadow computes and "
+                        "logs decisions without acting; off (default) "
+                        "keeps the legacy static paths byte-for-byte")
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"],
                    help="durability fsync policy for the write-ahead "
@@ -1122,6 +1141,8 @@ def main(argv=None):
     p.add_argument("--coalesce-max-queue", type=int, default=None)
     p.add_argument("--container-repr", default=None,
                    choices=["auto", "dense", "sparse", "rle"])
+    p.add_argument("--adaptive", default=None,
+                   choices=["off", "on", "shadow"])
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"])
     p.add_argument("--no-oplog", action="store_true", default=False)

@@ -293,8 +293,11 @@ class Executor:
         try:
             with tracing.start_span(
                     "executor.Execute", index=index_name) as span:
+                from . import adaptive as adaptive_mod
+
                 for call in query.calls:
                     t_call = _time.perf_counter()
+                    self._explain_tls.last = None
                     with tracing.start_span(
                             f"executor.execute{call.name}"):
                         if plan_nodes is None:
@@ -305,12 +308,21 @@ class Executor:
                                 idx, call, shards, opt)
                             results.append(result)
                             plan_nodes.append(node)
+                    call_wall = _time.perf_counter() - t_call
                     # per-PQL-op latency histogram (global registry: the
                     # executor predates any per-server stats wiring, and
                     # registry_of() resolves /metrics to this registry)
                     global_stats.timing(
-                        "query_op_seconds", _time.perf_counter() - t_call,
-                        {"op": call.name})
+                        "query_op_seconds", call_wall, {"op": call.name})
+                    if adaptive_mod.enabled():
+                        # observed per-shard fallback walls calibrate the
+                        # engine's est_fallback side (shadow learns too)
+                        last = getattr(self._explain_tls, "last", None)
+                        if last is not None and last[0] == call.name \
+                                and last[1].startswith("per-shard"):
+                            adaptive_mod.observe_fallback(
+                                call.name, call_wall,
+                                len(self._call_shards(idx, shards)))
                 if span is not None:
                     span.set_tag("calls", len(query.calls))
 
@@ -405,6 +417,10 @@ class Executor:
         from ..utils import workload as workload_mod
 
         workload_mod.note_strategy(op, strategy)
+        # last (op, strategy) taken on THIS thread — execute()'s per-call
+        # timing reads it to attribute fallback walls to the adaptive
+        # engine's per-shard calibration
+        self._explain_tls.last = (op, strategy)
         notes = getattr(self._explain_tls, "notes", None)
         prof = profile_mod.current()
         if notes is None and prof is None:
@@ -415,6 +431,164 @@ class Executor:
             notes.append(entry)
         if prof is not None:
             prof.note("strategies", entry)
+
+    # ------------------------------------------------------------ adaptive
+
+    def _adaptive_decide(self, op, idx, cover_call, shard_list, kernels,
+                         extra_missing_bytes=0):
+        """Stacked-vs-fallback pricing for one ELIGIBLE decision point.
+        Mirrors the planner's kernel map for the op (exec/plan.py builds
+        the same {family: n} before pricing), so the plan path and the
+        execute path reach the same decision from the same calibration.
+        Returns None when the engine is off or the static gates already
+        force the choice — a None means "behave exactly as before"."""
+        from . import adaptive
+        from .stacked import MIN_SHARDS
+
+        if not adaptive.enabled():
+            return None
+        if len(shard_list) < MIN_SHARDS:
+            return None
+        kernels = dict(kernels)
+        missing = int(extra_missing_bytes)
+        if cover_call is not None:
+            # side-effect-free residency walk (no stacks built, no heat)
+            probe = self._stacked.residency_probe(
+                idx, cover_call, tuple(shard_list))
+            if not probe.get("covered"):
+                return None
+            for family, n in probe.get("extra_kernels", {}).items():
+                kernels[family] = kernels.get(family, 0) + n
+            missing += int(probe.get("missing_bytes", 0))
+        return adaptive.decide_strategy(
+            op, kernels, len(shard_list), missing, stacked=self._stacked)
+
+    @staticmethod
+    def _chosen_detail(dec):
+        """EXPLAIN detail for a priced decision (empty when static)."""
+        return {} if dec is None else {"chosen_by": dec.chosen_by}
+
+    def _bsi_missing_bytes(self, idx, field, shard_list):
+        """Upload bytes a cold BSI stack build would pay — the planner's
+        (depth + 2) planes pricing (_plan_bsi_agg)."""
+        st = tuple(shard_list)
+        if self._stacked.bsi_stack_resident(idx, field.name, st):
+            return 0
+        plane = self._stacked._padded_len(st) * WORDS_PER_ROW * 4
+        return (field.options.bit_depth + 2) * plane
+
+    def _row_counts_decision(self, idx, field, call, candidates,
+                             filter_call, shard_list, view_name):
+        """Adaptive pricing for the chunked row-counts gate (TopN /
+        single-field GroupBy) — the planner's _plan_topn kernel map."""
+        from . import adaptive
+
+        if call is None or not adaptive.enabled():
+            return None
+        st = tuple(shard_list)
+        chunk = self._stacked.row_chunk_size(st)
+        n_chunks = -(-len(candidates) // chunk) if candidates else 0
+        kernels = {}
+        if n_chunks:
+            kernels["row_counts"] = n_chunks
+        if filter_call is not None:
+            kernels["filter"] = 1
+        missing_rows = 0
+        plane = self._stacked._padded_len(st) * WORDS_PER_ROW * 4
+        for i in range(0, len(candidates), chunk):
+            part = tuple(candidates[i:i + chunk])
+            if not self._stacked.rows_chunk_resident(
+                    idx, field.name, part, st, view_name):
+                missing_rows += len(part)
+        return self._adaptive_decide(
+            call.name, idx, filter_call, shard_list, kernels,
+            extra_missing_bytes=missing_rows * plane)
+
+    def maybe_proactive_admit(self, max_rows=None, max_bytes=None):
+        """Bounded proactive admission of hot_but_not_resident fragments
+        — called from idle windows (the coalescer drain loop between
+        batches) so demand heat translates into residency BEFORE the
+        next query pays the cold build. Skips entirely when the adaptive
+        engine is off or a dispatch is in flight (admission must never
+        queue behind — or ahead of — real serving traffic). Returns the
+        number of fragments admitted (shadow: candidates counted, none
+        built)."""
+        from . import adaptive
+        from ..utils import workload as workload_mod
+        from ..utils.stats import global_stats
+
+        if not adaptive.enabled():
+            return 0
+        st_eval = self._stacked
+        if st_eval._dispatch_lock.locked():
+            return 0
+        max_rows = adaptive.ADMIT_MAX_ROWS if max_rows is None \
+            else int(max_rows)
+        max_bytes = adaptive.ADMIT_MAX_BYTES if max_bytes is None \
+            else int(max_bytes)
+        try:
+            report = workload_mod.heat().report(
+                st_eval.hbm_snapshot(top=0), top=8)
+        except Exception:
+            return 0
+        candidates = report.get("hot_but_not_resident") or []
+        if not candidates:
+            return 0
+        adaptive.note_admission_round()
+        admitted = rows_built = bytes_built = 0
+        for cand in candidates:
+            if rows_built >= max_rows or bytes_built >= max_bytes:
+                break
+            idx = self.holder.index(cand["index"])
+            field = idx.field(cand["field"]) if idx is not None else None
+            if field is None:
+                continue
+            if not adaptive.acting():
+                adaptive.note_admission(cand["index"], cand["field"],
+                                        0, 0, shadow=True)
+                continue
+            shard_list = self._call_shards(idx, None)
+            if not shard_list:
+                continue
+            st = tuple(shard_list)
+            plane_bytes = st_eval._padded_len(st) * WORDS_PER_ROW * 4
+            frag_rows = frag_bytes = 0
+            from ..core.field import FIELD_TYPE_INT
+            if field.type == FIELD_TYPE_INT:
+                if st_eval.bsi_stack(idx, field.name, st) is None:
+                    continue
+                frag_rows = field.options.bit_depth + 2
+                frag_bytes = frag_rows * plane_bytes
+            else:
+                view = field.view(VIEW_STANDARD)
+                if view is None:
+                    continue
+                row_ids = sorted({r for shard in st
+                                  for frag in (view.fragment(shard),)
+                                  if frag is not None
+                                  for r in frag.row_ids()})
+                budget_rows = min(len(row_ids), max_rows - rows_built)
+                for row_id in row_ids[:budget_rows]:
+                    if bytes_built + frag_bytes >= max_bytes:
+                        break
+                    if st_eval.leaf_stack(idx, field.name, row_id,
+                                          st) is None:
+                        break
+                    frag_rows += 1
+                    frag_bytes += plane_bytes
+                if frag_rows == 0:
+                    continue
+            rows_built += frag_rows
+            bytes_built += frag_bytes
+            admitted += 1
+            # converge /debug/heat: the fragment is resident now, so its
+            # heat drops to the hot threshold and the candidate list
+            # stops re-recommending it (ISSUE 13 satellite)
+            workload_mod.heat().note_admitted(cand["index"], cand["field"])
+            adaptive.note_admission(cand["index"], cand["field"],
+                                    frag_rows, frag_bytes)
+            global_stats.count("stacked_admissions", 1, {"cause": "heat"})
+        return admitted
 
     def execute_call(self, idx, call, shards, opt):
         handler = {
@@ -967,9 +1141,13 @@ class Executor:
             raise ExecError("Count() takes exactly one row query")
         self.validate_bitmap_call(idx, call.children[0])
         shard_list = self._call_shards(idx, shards)
+        dec = self._adaptive_decide("Count", idx, call.children[0],
+                                    shard_list, {"count": 1})
         # Fast path: linearizable Row/set-op trees evaluate over ALL shards
         # in one fused dispatch on generation-cached [S, W] stacks.
-        fast = self._stacked.try_count(idx, call.children[0], shard_list)
+        fast = None if (dec is not None and dec.act
+                        and dec.strategy == "fallback") \
+            else self._stacked.try_count(idx, call.children[0], shard_list)
         if fast is not None:
             from ..utils import workload as workload_mod
             from .stacked import last_batch_size
@@ -978,11 +1156,13 @@ class Executor:
             # (group-commit batching stamps it on this thread); feeds
             # analyze actuals + SLOW QUERY batch= attribution
             n = last_batch_size() or 1
-            self._note_strategy("Count", "stacked", batch=n)
+            self._note_strategy("Count", "stacked", batch=n,
+                                **self._chosen_detail(dec))
             if n > 1:
                 workload_mod.note_batch(n)
             return fast
-        self._note_strategy("Count", "per-shard")
+        self._note_strategy("Count", "per-shard",
+                            **self._chosen_detail(dec))
 
         def count_shard(shard):
             plane = self.bitmap_call_shard(idx, call.children[0], shard)
@@ -1030,15 +1210,26 @@ class Executor:
         opts = field.options
         depth = opts.bit_depth
         shard_list = self._call_shards(idx, shards)
+        filter_call = self._agg_filter_call(idx, call)
+        kernels = {"sum": 1}
+        if filter_call is not None:
+            kernels["filter"] = 1
+        dec = self._adaptive_decide(
+            "Sum", idx, filter_call, shard_list, kernels,
+            extra_missing_bytes=self._bsi_missing_bytes(
+                idx, field, shard_list))
         # Fast path: one fused dispatch over stacked BSI planes for all
         # shards (falls back when the filter tree isn't stack-coverable).
-        fast = self._stacked.try_sum(
-            idx, field, self._agg_filter_call(idx, call), shard_list)
+        fast = None if (dec is not None and dec.act
+                        and dec.strategy == "fallback") \
+            else self._stacked.try_sum(idx, field, filter_call, shard_list)
         if fast is not None:
-            self._note_strategy("Sum", "stacked-sum")
+            self._note_strategy("Sum", "stacked-sum",
+                                **self._chosen_detail(dec))
             total, count = fast
             return ValCount(total + opts.base * count, count)
-        self._note_strategy("Sum", "per-shard")
+        self._note_strategy("Sum", "per-shard",
+                            **self._chosen_detail(dec))
 
         def sum_shard(shard):
             data = self._bsi_planes(field, shard)
@@ -1120,16 +1311,27 @@ class Executor:
         # [D, S, W] planes (globally — identical result to the per-shard
         # merge) instead of once per shard.
         op_name = "Max" if is_max else "Min"
-        fast = self._stacked.try_minmax(
-            idx, field, self._agg_filter_call(idx, call), shard_list,
-            is_max)
+        filter_call = self._agg_filter_call(idx, call)
+        kernels = {"minmax": 1}
+        if filter_call is not None:
+            kernels["filter"] = 1
+        dec = self._adaptive_decide(
+            op_name, idx, filter_call, shard_list, kernels,
+            extra_missing_bytes=self._bsi_missing_bytes(
+                idx, field, shard_list))
+        fast = None if (dec is not None and dec.act
+                        and dec.strategy == "fallback") \
+            else self._stacked.try_minmax(idx, field, filter_call,
+                                          shard_list, is_max)
         if fast is not None:
-            self._note_strategy(op_name, "stacked-minmax")
+            self._note_strategy(op_name, "stacked-minmax",
+                                **self._chosen_detail(dec))
             mag, count = fast
             if mag is None:
                 return ValCount()
             return ValCount(mag + field.options.base, count)
-        self._note_strategy(op_name, "per-shard")
+        self._note_strategy(op_name, "per-shard",
+                            **self._chosen_detail(dec))
         # Ordered reduce: larger/smaller tie-breaking is order-sensitive,
         # so the pool's shard-order reduction is what keeps every worker
         # count bit-identical to the serial loop.
@@ -1372,25 +1574,34 @@ class Executor:
 
         from .stacked import MIN_SHARDS
 
+        dec = None
         if len(shard_list) >= MIN_SHARDS:
             covered, filt = self._stacked.filter_stack(
                 idx, filter_call, tuple(shard_list))
             if covered:
                 candidates = self._candidate_rows(
                     field, shard_list, restrict_ids, use_cache, view_name)
-                totals = self._stacked.row_counts(
-                    idx, field.name, candidates, filt, shard_list,
-                    view_name)
+                dec = self._row_counts_decision(
+                    idx, field, call, candidates, filter_call,
+                    shard_list, view_name)
+                totals = None \
+                    if (dec is not None and dec.act
+                        and dec.strategy == "fallback") \
+                    else self._stacked.row_counts(
+                        idx, field.name, candidates, filt, shard_list,
+                        view_name)
                 if totals is not None:
                     if call is not None:
                         self._note_strategy(call.name,
-                                            "stacked-row-counts")
+                                            "stacked-row-counts",
+                                            **self._chosen_detail(dec))
                     if restrict_ids is not None:
                         for r in restrict_ids:
                             totals.setdefault(int(r), 0)
                     return totals
         if call is not None:
-            self._note_strategy(call.name, "per-shard-chunked")
+            self._note_strategy(call.name, "per-shard-chunked",
+                                **self._chosen_detail(dec))
 
         # Fallback: per-shard chains, but over the SAME global candidate
         # set as the fast path (union across fragments), so both paths
@@ -1541,18 +1752,29 @@ class Executor:
             lo = previous[0] + (1 if len(child_rows) == 1 else 0)
             child_rows[0] = [r for r in child_rows[0] if r >= lo]
 
-        totals = self._group_by_stacked(
+        dec, tile_dec, tile = self._group_by_decision(
             idx, fields, child_rows, filter_call, shard_list)
+        totals = None if (dec is not None and dec.act
+                          and dec.strategy == "fallback") \
+            else self._group_by_stacked(
+                idx, fields, child_rows, filter_call, shard_list,
+                tile=tile)
         if totals is None:
-            self._note_strategy("GroupBy", "per-shard")
+            self._note_strategy("GroupBy", "per-shard",
+                                **self._chosen_detail(dec))
             totals = self._group_by_per_shard(
                 idx, fields, child_rows, filter_call, shard_list)
         elif len(fields) == 1:
-            self._note_strategy("GroupBy", "stacked-row-counts")
+            self._note_strategy("GroupBy", "stacked-row-counts",
+                                **self._chosen_detail(dec))
         else:
-            tile = self._stacked.row_chunk_size(tuple(shard_list))
+            shown = tile if tile is not None \
+                else self._stacked.row_chunk_size(tuple(shard_list))
+            detail = self._chosen_detail(dec)
+            if tile_dec is not None:
+                detail["tile_chosen_by"] = tile_dec.chosen_by
             self._note_strategy("GroupBy", "stacked-pairwise",
-                                tile=[tile, tile])
+                                tile=[shown, shown], **detail)
         if previous is not None:
             prev_t = tuple(previous)
             totals = {g: c for g, c in totals.items() if g > prev_t}
@@ -1572,8 +1794,56 @@ class Executor:
             out = out[offset:]
         return out
 
+    def _group_by_decision(self, idx, fields, child_rows, filter_call,
+                           shard_list):
+        """(strategy decision, tile decision, tile override) for one
+        GroupBy — the planner's _plan_group_by kernel map. The tile
+        override is None unless the engine is acting AND chose a
+        non-static shape."""
+        from . import adaptive
+
+        if not adaptive.enabled():
+            return None, None, None
+        st = tuple(shard_list)
+        chunk = self._stacked.row_chunk_size(st)
+        plane = self._stacked._padded_len(st) * WORDS_PER_ROW * 4
+        # the planner prices cold row-chunk uploads the same way
+        # (_plan_group_by's _missing_row_chunks loop) — keep the two
+        # sides' est_stacked in agreement
+        missing = 0
+        for field, rows in zip(fields, child_rows):
+            for i in range(0, len(rows), chunk):
+                part = tuple(rows[i:i + chunk])
+                if not self._stacked.rows_chunk_resident(
+                        idx, field.name, part, st, VIEW_STANDARD):
+                    missing += len(part) * plane
+        if len(fields) == 1:
+            n = -(-len(child_rows[0]) // chunk) if child_rows[0] else 0
+            dec = self._adaptive_decide(
+                "GroupBy", idx, filter_call, shard_list,
+                {"row_counts": n} if n else {},
+                extra_missing_bytes=missing)
+            return dec, None, None
+        a_rows, b_rows = child_rows[-2], child_rows[-1]
+        outer = 1
+        for rows in child_rows[:-2]:
+            outer *= max(1, len(rows))
+        tile_dec = adaptive.decide_tile(
+            chunk, len(a_rows), len(b_rows), outer=outer) \
+            if a_rows and b_rows else None
+        tile = tile_dec.tile if (tile_dec is not None and tile_dec.act
+                                 and tile_dec.tile != chunk) else None
+        t = tile if tile is not None else chunk
+        pairwise = (-(-len(a_rows) // t)) * (-(-len(b_rows) // t)) \
+            * outer if a_rows and b_rows else 0
+        dec = self._adaptive_decide(
+            "GroupBy", idx, filter_call, shard_list,
+            {"pairwise": pairwise} if pairwise else {},
+            extra_missing_bytes=missing)
+        return dec, tile_dec, tile
+
     def _group_by_stacked(self, idx, fields, child_rows, filter_call,
-                          shard_list):
+                          shard_list, tile=None):
         """Thin driver over the stacked pairwise kernel: the innermost TWO
         levels are one tiled cross-product count matrix
         (StackedEvaluator.pairwise_counts — O(⌈R1/tile⌉·⌈R2/tile⌉) fused
@@ -1612,7 +1882,7 @@ class Executor:
             if level == len(fields) - 2:
                 groups = self._stacked.pairwise_counts(
                     idx, a_field.name, a_rows, b_field.name, b_rows,
-                    plane, shards)
+                    plane, shards, tile=tile)
                 if groups is None:
                     return False
                 for pair, c in groups.items():

@@ -415,6 +415,34 @@ class Planner:
     def _tree_size(self, call):
         return 1 + sum(self._tree_size(c) for c in call.children)
 
+    def _adaptive_choice(self, node, op, kernels, shard_list,
+                         fallback_strategy):
+        """Price the stacked-vs-fallback decision the executor will make
+        with the SAME inputs (kernel map + bytes_materialized) and
+        annotate it: `chosen_by` + both priced alternatives. With the
+        engine acting, a fallback-priced node mirrors the executor —
+        strategy flips to the per-shard variant — so plan-vs-actual
+        strategy agreement holds under --adaptive on. No-op when the
+        engine is off (legacy plans are byte-identical)."""
+        from . import adaptive
+
+        if not adaptive.enabled():
+            return None
+        dec = adaptive.decide_strategy(
+            op, kernels, len(shard_list),
+            node.estimate.get("bytes_materialized", 0),
+            stacked=self.stacked)
+        node.annotations["chosen_by"] = dec.chosen_by
+        node.annotations["alternatives"] = {
+            "stacked_ms": round(dec.est_stacked * 1000, 3),
+            "fallback_ms": round(dec.est_fallback * 1000, 3),
+            "cost_source": dec.source,
+        }
+        if dec.act and dec.strategy == "fallback":
+            node.strategy = fallback_strategy
+            node.reason = "cost-model: fallback priced cheaper"
+        return dec
+
     def _stacked_gate(self, node, idx, filter_call, shard_list):
         """The shared MIN_SHARDS + filter-coverage gate. Returns
         (eligible, probe) and records the blocking reason on the node."""
@@ -542,6 +570,8 @@ class Planner:
                 probe.get("compressed_bytes", dense_bytes)
             node.estimate["dense_bytes_touched"] = dense_bytes
             self.cost.price(node, kernels)
+            self._adaptive_choice(node, "Count", kernels, shard_list,
+                                  "per-shard")
         else:
             node.strategy = "per-shard"
             if not probe["covered"]:
@@ -609,6 +639,8 @@ class Planner:
             node.estimate["bytes_touched"] = \
                 (depth + 2) * self._plane_bytes(st)
             self.cost.price(node, kernels)
+            self._adaptive_choice(node, node.op, kernels, shard_list,
+                                  "per-shard")
         else:
             node.strategy = "per-shard"
             node.estimate["dispatches"] = 0
@@ -684,6 +716,8 @@ class Planner:
             node.estimate["bytes_touched"] = \
                 len(candidates) * self._plane_bytes(st)
             self.cost.price(node, kernels)
+            self._adaptive_choice(node, node.op, kernels, shard_list,
+                                  "per-shard-chunked")
         else:
             from .executor import _TOPN_STACK_CHUNK
 
@@ -808,11 +842,22 @@ class Planner:
             outer = 1
             for rows in child_rows[:-2]:
                 outer *= len(rows)
-            a_tiles = -(-len(a_rows) // chunk) if a_rows else 0
-            b_tiles = -(-len(b_rows) // chunk) if b_rows else 0
+            # mirror the executor's adaptive tile so the plan's shape
+            # and dispatch count match what execution will actually run
+            from . import adaptive
+
+            tile_dec = adaptive.decide_tile(
+                chunk, len(a_rows), len(b_rows), outer=outer) \
+                if (adaptive.enabled() and a_rows and b_rows) else None
+            t = tile_dec.tile if (tile_dec is not None
+                                  and tile_dec.act) else chunk
+            a_tiles = -(-len(a_rows) // t) if a_rows else 0
+            b_tiles = -(-len(b_rows) // t) if b_rows else 0
             pairwise = outer * a_tiles * b_tiles
-            node.annotations["tile"] = [min(len(a_rows), chunk),
-                                        min(len(b_rows), chunk)]
+            node.annotations["tile"] = [min(len(a_rows), t),
+                                        min(len(b_rows), t)]
+            if tile_dec is not None:
+                node.annotations["tile_chosen_by"] = tile_dec.chosen_by
             node.annotations["pairwise_tiles"] = [a_tiles, b_tiles]
             node.annotations["outer_combinations"] = outer
             if pairwise:
@@ -833,6 +878,8 @@ class Planner:
         node.estimate["bytes_touched"] = \
             total_rows * self._plane_bytes(st)
         self.cost.price(node, kernels)
+        self._adaptive_choice(node, "GroupBy", kernels, shard_list,
+                              "per-shard")
         return node
 
     # -- Options / writes ----------------------------------------------------
@@ -1003,4 +1050,29 @@ def flag_misestimates(node, factor=None):
     node.misestimates = flags
     if flags:
         _count_misestimate(node.op)
+        _adaptive_feedback(node, flags)
     return node
+
+
+def _adaptive_feedback(node, flags):
+    """Misestimates are the adaptive engine's correction signal (ISSUE
+    13 (c)): a kernel-wall deviation re-seeds the involved families'
+    EWMA calibration from the OBSERVED wall; a container_repr
+    misestimate strikes the node's fragments toward a forced-dense
+    rebuild. No-op when the engine is off."""
+    from . import adaptive
+
+    if not adaptive.enabled():
+        return
+    for f in flags:
+        if f["metric"] == "kernel_wall_seconds":
+            kernels = (node.actual or {}).get("kernels") \
+                or node.estimate.get("kernels") or {}
+            adaptive.note_wall_misestimate(
+                kernels, (node.actual or {}).get(
+                    "kernel_wall_seconds", 0.0))
+        elif f["metric"] == "container_repr":
+            from ..utils import workload
+
+            adaptive.note_repr_misestimate(
+                workload.current_index(), node.fields)

@@ -41,6 +41,7 @@ from ..utils import profile as _profile
 from ..utils import tracing as _tracing
 from ..utils import workload as _workload
 from ..utils.stats import global_stats
+from . import adaptive as _adaptive
 
 
 class GroupCommit:
@@ -706,6 +707,32 @@ class StackedEvaluator:
         global_stats.count("stacked_evictions", n,
                            {"pool": pool_name, "cause": cause})
 
+    def _pop_victim(self, pool):
+        """One over-budget victim (caller holds self._lock). Legacy LRU
+        (FIFO position) when the adaptive engine is off; lowest
+        heat×cost benefit score when on — which may be the entry just
+        inserted, making the score an admission filter too; shadow
+        scores, counts the divergence, and still evicts LRU. Heat reads
+        are decayed point lookups in the workload ledger (its own lock —
+        the ledger never calls back into this module, so the ordering is
+        one-way)."""
+        amode = _adaptive.cache_mode()
+        lru_key = next(iter(pool))
+        if amode == "off":
+            ekey = lru_key
+        else:
+            heat = _workload.heat()
+            best = _adaptive.select_victim(
+                [(k, heat.value(*self._heat_key(k)), e[2])
+                 for k, e in pool.items()])
+            if amode == "on":
+                ekey = best
+                _adaptive.note_eviction("benefit")
+            else:
+                ekey = lru_key
+                _adaptive.note_eviction("lru", diverged=best != lru_key)
+        return ekey, pool.pop(ekey)
+
     def _cache_put(self, key, gens, arrays, nbytes, stamp=None):
         pool, budget = self._pool(key)
         rows = pool is self._rows_stacks
@@ -726,7 +753,7 @@ class StackedEvaluator:
             if rows:
                 self._rows_stack_bytes += nbytes
                 while self._rows_stack_bytes > budget and len(pool) > 1:
-                    ekey, evicted = pool.popitem(last=False)
+                    ekey, evicted = self._pop_victim(pool)
                     self._rows_stack_bytes -= evicted[2]
                     self.evictions += 1
                     self._ledger_add(ekey, -evicted[2],
@@ -736,7 +763,7 @@ class StackedEvaluator:
             else:
                 self._stack_bytes += nbytes
                 while self._stack_bytes > budget and len(pool) > 1:
-                    ekey, evicted = pool.popitem(last=False)
+                    ekey, evicted = self._pop_victim(pool)
                     self._stack_bytes -= evicted[2]
                     self.evictions += 1
                     self._ledger_add(ekey, -evicted[2],
@@ -1775,7 +1802,7 @@ class StackedEvaluator:
         return out
 
     def pairwise_counts(self, idx, a_field, a_rows, b_field, b_rows, filt,
-                        shards, view_name=VIEW_STANDARD):
+                        shards, view_name=VIEW_STANDARD, tile=None):
         """{(a_row, b_row): count > 0} of the two-field GroupBy cross
         product: counts[i, j] = popcount(a_rows[i] & b_rows[j] & filt)
         summed over `shards`. Both fields' row stacks come from the rows
@@ -1784,13 +1811,18 @@ class StackedEvaluator:
         per (A-tile, B-tile) pair — O(⌈R1/tile⌉·⌈R2/tile⌉) round trips
         total, vs the recursive path's one `row_counts` sync per A row.
         The sync rides the group commit, so concurrent GroupBys (and any
-        Sum/Min/Max traffic) share round trips. Returns None when a
-        field/view vanished mid-query (caller falls back)."""
+        Sum/Min/Max traffic) share round trips. `tile` overrides the
+        static CHUNK_BYTES-derived shape (the adaptive tile decision);
+        per-dispatch walls feed back into the engine's per-tile EWMA.
+        Returns None when a field/view vanished mid-query (caller falls
+        back)."""
         shards = tuple(shards)
         out = {}
         if not a_rows or not b_rows:
             return out
-        tile = self.row_chunk_size(shards)
+        if tile is None or tile < 1:
+            tile = self.row_chunk_size(shards)
+        observe = _adaptive.enabled()
         row_bytes = self._padded_len(shards) * WORDS_PER_ROW * 4
         cache_a = len(a_rows) * row_bytes <= MAX_ROWS_STACK_BYTES
         cache_b = len(b_rows) * row_bytes <= MAX_ROWS_STACK_BYTES
@@ -1812,6 +1844,7 @@ class StackedEvaluator:
                 self.pairwise_dispatches += 1
                 n_in = (a_stack.size + b_stack.size
                         + (filt.size if filt is not None else 0)) * 4
+                t_disp = time.perf_counter() if observe else 0.0
                 with self._locked_dispatch(
                         "pairwise", nbytes_in=n_in,
                         nbytes_out=len(a_chunk) * len(b_chunk) * 8) as ph:
@@ -1824,6 +1857,12 @@ class StackedEvaluator:
                         # pair (same discipline as row_counts).
                         jax.block_until_ready((hi, lo))
                     ph.mark("sync")
+                if observe:
+                    # calibrate per-dispatch wall at the NOMINAL tile —
+                    # ragged last tiles blend in, which is fine: the
+                    # model prices whole shapes, not individual tiles
+                    _adaptive.observe_pairwise(
+                        tile, time.perf_counter() - t_disp)
                 # ONE host sync for the whole [tile, tile] matrix, shared
                 # with concurrent serving traffic via the group commit
                 vals = self._fetch_commit.submit((hi, lo),

@@ -222,6 +222,32 @@ def fragment_ledger():
 def reset_ledger():
     with _LEDGER_LOCK:
         _LEDGER.clear()
+        _REPR_OVERRIDES.clear()
+
+
+# Per-(index, field) representation overrides from the adaptive layer's
+# misestimate feedback: a fragment whose container_repr plan repeatedly
+# reads MORE bytes than the dense scan it displaced gets forced dense at
+# its next rebuild. Consulted in build() only under auto mode — forced
+# --container-repr modes are the operator's word and win.
+_REPR_OVERRIDES = {}  # (index, field) -> kind
+
+
+def set_repr_override(index, field, kind):
+    if kind not in _ARITY:
+        raise ValueError(f"unknown container repr: {kind!r}")
+    with _LEDGER_LOCK:
+        _REPR_OVERRIDES[(index, field)] = kind
+
+
+def repr_override(index, field):
+    with _LEDGER_LOCK:
+        return _REPR_OVERRIDES.get((index, field))
+
+
+def repr_overrides():
+    with _LEDGER_LOCK:
+        return {f"{i}/{f}": k for (i, f), k in _REPR_OVERRIDES.items()}
 
 
 # --------------------------------------------------------------- container
@@ -474,6 +500,11 @@ def build(host_stack, place_sharded, place_replicated, mode=None,
     s, w = host_stack.shape
     info = analyze(host_stack)
     kind = choose(info, s, w, mode)
+    if ((mode or repr_mode()) == "auto" and fragment is not None
+            and len(fragment) >= 2):
+        override = repr_override(fragment[0], fragment[1])
+        if override is not None:
+            kind = override
     if kind == "sparse":
         ids, blocks = _sparse_host(host_stack)
         arrays = (place_replicated(ids), place_replicated(blocks))

@@ -297,6 +297,20 @@ class QueryCoalescer:
         ex = getattr(self.api.executor, "local", self.api.executor)
         pending = []  # [(handle, state, members)] launched, unresolved
         while True:
+            idle = False
+            with self._cond:
+                idle = not self._queue and not pending and not self._closed
+            if idle:
+                # idle dispatch-lock window: bounded proactive admission
+                # of hot_but_not_resident fragments (exec/adaptive) —
+                # exception-guarded and a no-op with the engine off, so
+                # serving can never wedge on an admission failure
+                try:
+                    admit = getattr(ex, "maybe_proactive_admit", None)
+                    if admit is not None:
+                        admit()
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
             with self._cond:
                 while not self._queue and not pending \
                         and not self._closed:

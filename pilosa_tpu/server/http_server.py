@@ -167,6 +167,7 @@ class PilosaHTTPServer:
                   args=("top",)),
             Route("GET", r"/debug/heat", self._get_debug_heat,
                   args=("top",)),
+            Route("GET", r"/debug/optimizer", self._get_debug_optimizer),
             Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
             Route("GET", r"/debug/faultpoints", self._get_faultpoints),
@@ -757,6 +758,9 @@ class PilosaHTTPServer:
                            "p50/p99, strategies, misestimates",
         "/debug/heat": "fragment heat vs HBM residency: admission and "
                        "eviction candidates",
+        "/debug/optimizer": "adaptive execution engine: calibration "
+                            "sources, decision counters, recent "
+                            "decisions",
         "/debug/slo": "SLO objectives and multi-window error-budget "
                       "burn rates",
         "/debug/oplog": "write-ahead oplog: LSNs, checkpoint, fsync "
@@ -795,6 +799,17 @@ class PilosaHTTPServer:
             if hasattr(local, "hbm_stats") else None
         return workload_mod.heat().report(
             hbm, top=int(self._q1(req, "top", "50")))
+
+    def _get_debug_optimizer(self, req):
+        """Adaptive execution engine state: mode, per-kernel-family
+        calibration with sources (ewma|cost_analysis|default), strategy/
+        tile/cache/admission decision counters, and the recent-decision
+        ring (exec/adaptive.py)."""
+        from ..exec import adaptive
+
+        local = self._local_executor()
+        return adaptive.snapshot(
+            stacked=getattr(local, "_stacked", None))
 
     def _get_debug_slo(self, req):
         """SLO objectives with fast/slow-window error-budget burn rates

@@ -22,6 +22,11 @@ from collections import defaultdict
 TIMING_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: smoothing factor for the per-series timing EWMA — ~last 5 samples
+#: dominate, so a post-warmup regime shift shows within a handful of
+#: observations where the cumulative mean would take thousands
+EWMA_ALPHA = 0.2
+
 
 def _key(name, tags):
     if not tags:
@@ -77,9 +82,12 @@ class StatsClient:
         self._counters = defaultdict(float)
         self._gauges = {}
         self._gauge_fns = {}
-        # per series: [count, total seconds, per-bucket counts (+Inf last)]
+        # per series: [count, total seconds, per-bucket counts (+Inf
+        # last), EWMA seconds]. Fields 0-2 are the cumulative series
+        # /metrics exposes (unchanged forever); field 3 is the
+        # recency-weighted view the adaptive layer calibrates from.
         self._timings = defaultdict(
-            lambda: [0, 0.0, [0] * (len(TIMING_BUCKETS) + 1)])
+            lambda: [0, 0.0, [0] * (len(TIMING_BUCKETS) + 1), 0.0])
 
     def count(self, name, value=1, tags=None):
         with self._lock:
@@ -103,6 +111,9 @@ class StatsClient:
             t[0] += 1
             t[1] += seconds
             t[2][bisect.bisect_left(TIMING_BUCKETS, seconds)] += 1
+            # first sample seeds the EWMA; later samples alpha-blend
+            t[3] = seconds if t[0] == 1 \
+                else t[3] + EWMA_ALPHA * (seconds - t[3])
 
     def snapshot(self):
         """(counters, gauges, timings) — timings as (count, sum) pairs;
@@ -134,6 +145,25 @@ class StatsClient:
         with self._lock:
             return {k: (v[0], v[1]) for k, v in self._timings.items()
                     if k[0] == name}
+
+    def timing_ewma(self, name):
+        """{(name, tags): (ewma_seconds, count)} for ONE timing family —
+        the recency-weighted companion to `timing_summary`. The
+        cumulative /metrics series are untouched; this view exists so
+        the adaptive layer can forget a slow cold-start regime."""
+        with self._lock:
+            return {k: (v[3], v[0]) for k, v in self._timings.items()
+                    if k[0] == name}
+
+    def timing_ewma_force(self, name, seconds, tags=None):
+        """Overwrite a series' EWMA with an observed value WITHOUT
+        touching the cumulative count/sum/buckets — the misestimate
+        feedback path: a >3× plan-vs-actual deviation re-seeds the
+        calibration from reality instead of waiting for the blend to
+        catch up."""
+        with self._lock:
+            t = self._timings[_key(name, tags)]
+            t[3] = seconds
 
     def prometheus_text(self):
         """Prometheus exposition format (reference: prometheus/prometheus.go

@@ -261,6 +261,36 @@ class HeatLedger:
         dt = now - e[1]
         return e[0] * 0.5 ** (dt / self.half_life) if dt > 0 else e[0]
 
+    def value(self, index, field, view, now=None):
+        """Current decayed heat of ONE key (0.0 if untracked) — the
+        cache benefit score's read path, so it must stay a single dict
+        lookup plus one pow."""
+        with self._lock:
+            e = self._heat.get((index, field, view))
+            if e is None:
+                return 0.0
+            return self._decayed(e, time.time() if now is None else now)
+
+    def note_admitted(self, index, field, now=None):
+        """An admission driven by hot_but_not_resident landed: scale the
+        (index, field) group's summed heat down to exactly HEAT_HOT_MIN.
+        Below the threshold the group can't re-recommend (the list
+        converges, ISSUE 13 satellite); pinning AT the threshold — not
+        zero — keeps the fresh admission out of resident_but_cold, which
+        would nominate it for instant eviction."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            group = [(k, e) for k, e in self._heat.items()
+                     if k[0] == index and k[1] == field]
+            total = sum(self._decayed(e, now) for _, e in group)
+            if total <= HEAT_HOT_MIN or total <= 0:
+                return
+            scale = HEAT_HOT_MIN / total
+            for _, e in group:
+                e[0] = self._decayed(e, now) * scale
+                e[1] = now
+
     def snapshot(self, now=None):
         """All tracked keys with their current (decayed) heat, hottest
         first."""
@@ -701,6 +731,14 @@ def note_misestimate():
 def current_fingerprint():
     ctx = getattr(_local, "ctx", None)
     return ctx.fingerprint if ctx is not None else None
+
+
+def current_index():
+    """Index of the in-flight query on THIS thread (None outside one) —
+    exec/plan's misestimate feedback uses it to strike container-repr
+    overrides at (index, field) granularity."""
+    ctx = getattr(_local, "ctx", None)
+    return ctx.index if ctx is not None else None
 
 
 def last_fingerprint():
