@@ -15,12 +15,12 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
-	test-workload test-batching test-containers test-adaptive lint \
-	bench-cpu
+	test-workload test-batching test-containers test-adaptive \
+	test-ingest lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
-	test-containers test-adaptive
+	test-containers test-adaptive test-ingest
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -84,6 +84,13 @@ test-parallel:
 # /debug compression surfaces.
 test-containers:
 	$(PY) -m pytest tests/test_containers.py $(PYTEST_FLAGS)
+
+# Streaming ingest surface: the delta buffer + interval merge engine
+# (flush == legacy differential across reprs, overflow back-pressure,
+# crash-window replay, idle-window merge exclusion, serve-stale
+# accounting) and /debug/ingest.
+test-ingest:
+	$(PY) -m pytest tests/test_ingest.py $(PYTEST_FLAGS)
 
 # Adaptive execution surface: cost-model strategy/tile decisions, the
 # heat×cost cache policy, proactive admission, shadow-mode A/B, the

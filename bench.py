@@ -94,6 +94,18 @@ def _adaptive_tag():
         return None, None
 
 
+def _ingest_mode():
+    """Streaming ingest engine mode ("off" or "interval=<n>s") tagged
+    into every emitted record — write-path numbers are only comparable
+    across runs measured under the same delta-buffer policy."""
+    try:
+        from pilosa_tpu.exec import ingest
+
+        return ingest.mode()
+    except Exception:
+        return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -307,6 +319,9 @@ def main():
             # steering the run it is comparing against
             "adaptive_mode": adaptive_mode,
             "adaptive_decisions": adaptive_decisions,
+            # streaming ingest engine mode: write-path comparisons must
+            # be like-for-like on the delta-buffer policy too
+            "ingest_mode": _ingest_mode(),
         },
     }))
 

@@ -1813,6 +1813,155 @@ def bench_adaptive():
         "adaptive_decisions": on_counts})
 
 
+def bench_ingest_qps():
+    """Streaming ingest acceptance leg (ISSUE 14).
+
+    Three claims, one JSON line:
+    1. Sustained write+read pairs run >=3x faster with the delta-
+       buffered merge engine than the legacy path, where every write
+       forces the next read through a per-fragment patch dispatch.
+    2. Read p99 during sustained ingest stays within 1.25x the
+       write-free baseline — serve-stale keeps the read path off the
+       repair treadmill while deltas fold in idle-window merges.
+    3. With --ingest-merge-interval 0 the hooks left on the legacy
+       path (an engine-is-None check per import) cost <2% of one
+       import ack — disabled means free.
+    """
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor as Executor_cls
+    from pilosa_tpu.exec import ingest as ingest_mod
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils.stats import global_stats
+    import jax
+
+    platform = jax.devices()[0].platform
+    n_shards = 4
+    seed_cols = 200
+    rng = np.random.default_rng(14)
+
+    def open_env(tag, **api_kwargs):
+        tmp = tempfile.mkdtemp(prefix=f"pilosa-bench-ingest-{tag}-")
+        holder = Holder(tmp).open()
+        holder._bench_tmp = tmp
+        api = API(holder, **api_kwargs)
+        return holder, api, Executor_cls(holder)
+
+    def seed(api):
+        api.create_index("ing")
+        api.create_field("ing", "f")
+        for shard in range(n_shards):
+            c = rng.choice(SHARD_WIDTH, size=seed_cols, replace=False)
+            api.import_bits("ing", "f", [1] * seed_cols,
+                            (shard * SHARD_WIDTH + c).tolist())
+
+    def fresh_cols(i):
+        # unique never-seen columns in shard 0: one shard of four
+        # drifts, so legacy reads stay on the (expensive) patch path
+        base = seed_cols + i * 8
+        return [base + j for j in range(8)]
+
+    def patch_count(path):
+        key = ("stacked_patches", (("path", path),))
+        return global_stats._counters.get(key, 0)
+
+    # --- write-free read baseline -------------------------------------
+    holder, api, ex = open_env("base")
+    seed(api)
+    ex.execute("ing", "Count(Row(f=1))")  # build + warm the stack
+    lat = []
+    for _ in range(300):
+        t0 = time.perf_counter()
+        ex.execute("ing", "Count(Row(f=1))")
+        lat.append(time.perf_counter() - t0)
+    base_p99_ms = float(np.percentile(lat, 99)) * 1000
+
+    # disabled-path overhead: the engine-is-None hooks, priced against
+    # one legacy import ack
+    t0 = time.perf_counter()
+    for i in range(300):
+        api.import_bits("ing", "f", [2] * 8, fresh_cols(i))
+    ack_ms = (time.perf_counter() - t0) / 300 * 1000
+    n_probe = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        api._ingest_admit(8, 128)
+        api._oplog_applied_or_defer(None)
+    hook_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = hook_ns / 1e6 / ack_ms * 100
+    assert api.ingest is None and overhead_pct < 2.0, (
+        f"disabled-path hooks cost {overhead_pct:.3f}% of an import ack "
+        "(gate 2%) — interval=0 is no longer free")
+    _close(holder)
+
+    # --- legacy: every write drags the next read through a patch ------
+    n_legacy = 200
+    holder, api, ex = open_env("legacy")
+    seed(api)
+    ex.execute("ing", "Count(Row(f=1))")
+    t0 = time.perf_counter()
+    for i in range(n_legacy):
+        api.import_bits("ing", "f", [1] * 8, fresh_cols(i))
+        ex.execute("ing", "Count(Row(f=1))")
+    legacy_qps = n_legacy / (time.perf_counter() - t0)
+    _close(holder)
+
+    # --- merge engine: serve-stale reads, interval-batched folds ------
+    n_merge = 1000
+    holder, api, ex = open_env("merge", ingest_interval=0.5)
+    seed(api)
+    api.ingest.flush()  # fold the seed churn; start the window clean
+    ex.execute("ing", "Count(Row(f=1))")
+    read0 = patch_count("read")
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(n_merge):
+        api.import_bits("ing", "f", [1] * 8, fresh_cols(i))
+        t1 = time.perf_counter()
+        ex.execute("ing", "Count(Row(f=1))")
+        lat.append(time.perf_counter() - t1)
+    merge_qps = n_merge / (time.perf_counter() - t0)
+    merge_p99_ms = float(np.percentile(lat, 99)) * 1000
+    read_patches = patch_count("read") - read0
+    assert read_patches == 0, (
+        f"{read_patches} reads repaired stacks whose deltas were "
+        "pending — serve-stale is not holding")
+    api.ingest.flush()
+    merges = api.ingest.merges
+    final = ex.execute("ing", "Count(Row(f=1))")[0]
+    want = n_shards * seed_cols + n_merge * 8
+    assert final == want, (
+        f"post-flush count {final} != {want} — the merge lost writes")
+    mode = ingest_mod.mode()
+    _close(holder)
+
+    speedup = merge_qps / legacy_qps
+    assert speedup >= 3.0, (
+        f"merge path only reached {merge_qps:.1f} write+read pairs/s vs "
+        f"legacy {legacy_qps:.1f} ({speedup:.2f}x, gate 3x)")
+    assert merge_p99_ms <= base_p99_ms * 1.25, (
+        f"read p99 under sustained ingest {merge_p99_ms:.2f}ms vs "
+        f"write-free {base_p99_ms:.2f}ms (gate 1.25x)")
+
+    _emit("ingest_qps", merge_qps, legacy_qps, {
+        "platform": platform, "n_shards": n_shards,
+        "ingest_mode": mode,
+        "pairs_merge": n_merge, "pairs_legacy": n_legacy,
+        "merge_pair_qps": round(merge_qps, 1),
+        "legacy_pair_qps": round(legacy_qps, 1),
+        "speedup": round(speedup, 2),
+        "read_p99_ms": round(merge_p99_ms, 3),
+        "read_p99_write_free_ms": round(base_p99_ms, 3),
+        "read_p99_ratio": round(merge_p99_ms / base_p99_ms, 3),
+        "read_patches_during_ingest": read_patches,
+        "interval_merges": merges,
+        "import_ack_ms": round(ack_ms, 3),
+        "disabled_hook_ns": round(hook_ns, 1),
+        "disabled_overhead_pct": round(overhead_pct, 4)})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -1829,6 +1978,7 @@ CONFIGS = {
     "batching_qps": bench_batching_qps,
     "compression": bench_compression,
     "adaptive": bench_adaptive,
+    "ingest_qps": bench_ingest_qps,
 }
 
 

@@ -222,6 +222,10 @@ def cmd_server(args):
     cw = config.get("coalesce-window")
     coalesce_window = parse_duration(str(cw)) if cw else 0.0
     coalesce_max_queue = int(config.get("coalesce-max-queue", 256))
+    # Streaming ingest engine: interval 0 — the default — keeps the
+    # legacy apply-then-invalidate write path byte-identical.
+    imi = config.get("ingest-merge-interval")
+    ingest_interval = parse_duration(str(imi)) if imi else 0.0
     spmd = None
     if spmd_requested and cluster is not None:
         from .cluster.spmd import SpmdDataPlane
@@ -236,7 +240,8 @@ def cmd_server(args):
               max_writes_per_request=int(mwpr),
               spmd=spmd, oplog=oplog,
               coalesce_window=coalesce_window,
-              coalesce_max_queue=coalesce_max_queue)
+              coalesce_max_queue=coalesce_max_queue,
+              ingest_interval=ingest_interval)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -807,7 +812,7 @@ def _apply_server_flags(config, args):
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
                  "coalesce_window", "coalesce_max_queue",
-                 "container_repr", "adaptive"):
+                 "container_repr", "adaptive", "ingest_merge_interval"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -1031,6 +1036,14 @@ def main(argv=None):
                         "cost model + fragment heat; shadow computes and "
                         "logs decisions without acting; off (default) "
                         "keeps the legacy static paths byte-for-byte")
+    p.add_argument("--ingest-merge-interval", default=None,
+                   help="streaming ingest merge interval (e.g. 250ms): "
+                        "import deltas buffer host-side (still "
+                        "WAL-durable at ack) and fold into resident "
+                        "device stacks in one batched donated merge per "
+                        "interval; reads serve the pre-merge snapshot "
+                        "meanwhile (default 0 = disabled, legacy "
+                        "apply-then-invalidate path)")
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"],
                    help="durability fsync policy for the write-ahead "

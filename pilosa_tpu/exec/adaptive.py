@@ -73,6 +73,13 @@ UPLOAD_SECONDS_PER_BYTE = 1.0 / (8 << 30)
 #: benefit score — keeps small-but-hot entries from scoring as free
 REBUILD_FIXED_SECONDS = DEFAULT_DISPATCH_SECONDS
 
+#: on-device bytes-touched pricing for incremental stack patches: the
+#: scatter's functional update copies the whole resident stack at HBM
+#: speed (~64 GB/s effective), which is ~8x cheaper per byte than the
+#: host→device re-upload a rebuild pays — so the priced patch/rebuild
+#: cutoff lands near 7/8 of the shards drifted, not the static half
+DEVICE_TOUCH_SECONDS_PER_BYTE = 1.0 / (64 << 30)
+
 #: proactive admission bounds per idle window: never more than this many
 #: leaf builds / bytes in one round, so admission can't monopolize the
 #: dispatch lock ahead of real queries
@@ -111,6 +118,7 @@ _admission_counters = {
 }
 _calibration_bumps = {}  # family -> count (wall-misestimate feedback)
 _repr_strikes = {}       # (index, field) -> strikes
+_patch_counts = {"patch": 0, "rebuild": 0}  # decide_patch outcomes
 
 
 def configure(mode=None, forced_tile=None):
@@ -167,6 +175,8 @@ def reset():
             _admission_counters[k] = 0
         _calibration_bumps.clear()
         _repr_strikes.clear()
+        for k in _patch_counts:
+            _patch_counts[k] = 0
 
 
 # ------------------------------------------------------------- calibration
@@ -360,6 +370,32 @@ def decide_strategy(op, kernels, n_shards, missing_bytes=0, stacked=None):
     return dec
 
 
+def decide_patch(n_changed, n_shards, rows, plane_bytes):
+    """Price the read-path patch-vs-rebuild cutoff for a stale cached
+    stack with `n_changed` of `n_shards` drifted shard rows (`rows`
+    planes of `plane_bytes` each per shard). Patch = one dispatch +
+    upload only the drifted planes + the on-device copy of the whole
+    stack the functional scatter pays; rebuild = one dispatch + re-upload
+    of every plane. Returns True to patch. Only consulted when
+    acting() — off/shadow keep exec/stacked's static half-the-shards
+    rule, so the default path stays byte-identical."""
+    row_bytes = rows * plane_bytes
+    est_patch = (DEFAULT_DISPATCH_SECONDS
+                 + n_changed * row_bytes * UPLOAD_SECONDS_PER_BYTE
+                 + n_shards * row_bytes * DEVICE_TOUCH_SECONDS_PER_BYTE)
+    est_rebuild = (REBUILD_FIXED_SECONDS
+                   + n_shards * row_bytes * UPLOAD_SECONDS_PER_BYTE)
+    patch = est_patch <= est_rebuild
+    with _lock:
+        _patch_counts["patch" if patch else "rebuild"] += 1
+    _record_decision("patch", {
+        "changed": n_changed, "shards": n_shards, "rows": rows,
+        "patch": patch, "acted": True,
+        "est_patch_ms": round(est_patch * 1000, 3),
+        "est_rebuild_ms": round(est_rebuild * 1000, 3)})
+    return patch
+
+
 class TileDecision:
     __slots__ = ("tile", "act", "estimates", "source", "chosen_by")
 
@@ -527,6 +563,7 @@ def snapshot(stacked=None):
             "decisions": {
                 "strategy": strategy,
                 "tile": dict(sorted(_tile_counts.items())),
+                "patch": dict(_patch_counts),
                 "cache": dict(_cache_counters),
                 "admission": dict(_admission_counters),
             },
@@ -547,6 +584,7 @@ def decision_counts():
         return {
             "strategy": strategy,
             "tile": {str(t): n for t, n in _tile_counts.items()},
+            "patch": dict(_patch_counts),
             "cache": dict(_cache_counters),
             "admission": dict(_admission_counters),
         }

@@ -42,6 +42,7 @@ from ..utils import tracing as _tracing
 from ..utils import workload as _workload
 from ..utils.stats import global_stats
 from . import adaptive as _adaptive
+from . import ingest as _ingest
 
 
 class GroupCommit:
@@ -480,6 +481,10 @@ class StackedEvaluator:
         # assert planes_uploaded stays O(changed shards) under writes.
         self.patches = 0
         self.planes_uploaded = 0
+        # Streaming-ingest observability: reads served from a stale
+        # stack whose drift is fully covered by pending ingest deltas
+        # (the merge folds them off the read path; exec/ingest.py).
+        self.stale_serves = 0
         # Pairwise GroupBy observability: dispatches and host syncs must
         # stay O(⌈R1/tile⌉·⌈R2/tile⌉) for a two-field cross product —
         # tests assert these, not wall time (which is noisy on CPU).
@@ -776,6 +781,56 @@ class StackedEvaluator:
             _flightrec.record("cache.evict", pool=pool_name, index=ekey[1],
                               field=ekey[2], bytes=ebytes, cause="budget")
 
+    def merge_swap(self, key, old_entry, gens, arrays, nbytes):
+        """Install an ingest merge's result over the exact entry it was
+        planned from (identity compare — a concurrent rebuild or
+        eviction wins and the merge result is dropped). The entry
+        updates IN PLACE under the lock; the stamp resets to None so the
+        next read revalidates with one gens walk instead of trusting a
+        view-stamp that predates the merge. Returns True on install."""
+        pool, _ = self._pool(key)
+        rows_pool = pool is self._rows_stacks
+        with self._lock:
+            cur = pool.get(key)
+            if cur is not old_entry:
+                return False
+            old_bytes = cur[2]
+            old_kind = _containers.kind_of(cur[1])
+            cur[0] = gens
+            cur[1] = arrays
+            cur[2] = nbytes
+            cur[3] = None
+            cur[4] = time.time()
+            if rows_pool:
+                self._rows_stack_bytes += nbytes - old_bytes
+            else:
+                self._stack_bytes += nbytes - old_bytes
+            self._ledger_add(key, -old_bytes, old_kind)
+            self._ledger_add(key, nbytes, _containers.kind_of(arrays))
+        self._note_patch("merge")
+        return True
+
+    def merge_drop(self, key, old_entry):
+        """Evict an entry the ingest merge decided not to fold (too
+        drifted, vanished field): the next read rebuilds cold. Identity
+        compare like merge_swap. Returns True when dropped."""
+        pool, _ = self._pool(key)
+        rows_pool = pool is self._rows_stacks
+        with self._lock:
+            cur = pool.get(key)
+            if cur is not old_entry:
+                return False
+            pool.pop(key)
+            if rows_pool:
+                self._rows_stack_bytes -= cur[2]
+            else:
+                self._stack_bytes -= cur[2]
+            self.evictions += 1
+            self._ledger_add(key, -cur[2], _containers.kind_of(cur[1]))
+            self._count_eviction("rows" if rows_pool else "stack",
+                                 "ingest")
+        return True
+
     def leaf_stack(self, idx, field_name, row_id, shards):
         """Cached Container of one row's [S, W] plane stack over
         `shards` — the per-fragment representation chooser's call site:
@@ -811,6 +866,9 @@ class StackedEvaluator:
         # again.
         stale = self._stale_entry(key, gens)
         if stale is not None:
+            if self._serve_stale(key, idx.name, field_name, VIEW_STANDARD,
+                                 shards, stale, gens):
+                return stale[1]
             changed = self._changed_shards(stale[0], gens, shards)
             if changed is not None:
                 import jax.numpy as jnp
@@ -821,8 +879,7 @@ class StackedEvaluator:
                 ent = stale[1]
                 if isinstance(ent, _containers.Container) \
                         and ent.kind != "dense":
-                    old = _containers.to_dense(
-                        (ent.kind, ent.arrays, ent.shape[0]))
+                    old = _containers.container_to_dense(ent)
                 elif isinstance(ent, _containers.Container):
                     old = ent.arrays[0]
                 else:
@@ -830,7 +887,7 @@ class StackedEvaluator:
                 stack = self._place(
                     old.at[np.asarray(changed)].set(
                         jnp.asarray(block[0])), shard_axis=0)
-                self.patches += 1
+                self._note_patch("read")
                 cont = _containers.dense_container(stack)
                 self._cache_put(key, gens, cont, cont.nbytes, stamp)
                 return cont
@@ -882,13 +939,45 @@ class StackedEvaluator:
                 return None
             return entry
 
-    def _changed_shards(self, old_gens, gens, shards):
-        """Stack row indices whose (uid, generation) drifted, or None when
-        a device patch isn't worthwhile (more than half the shards moved —
-        the scatter would cost about as much as a rebuild)."""
+    def _note_patch(self, path):
+        """Count one incremental stack patch, tagged by where it ran:
+        "read" = legacy in-query repair, "merge" = the ingest engine's
+        interval fold. Exported as stacked_patches_total{path} so the two
+        are distinguishable on /metrics (the ingest tests assert the
+        read-path count stays flat while deltas are pending)."""
+        self.patches += 1
+        global_stats.count("stacked_patches", 1, {"path": path})
+
+    def _serve_stale(self, key, index_name, field_name, view_name, shards,
+                     stale, gens):
+        """True when a stale entry may serve AS-IS because every drifted
+        shard is covered by a pending ingest delta (exec/ingest.py) — the
+        interval merge folds the drift off the read path; staleness is
+        bounded by the merge interval. One list check when no ingest
+        engine is active (the default)."""
+        if not _ingest.covers_pending(index_name, field_name, view_name,
+                                      shards, stale[0], gens):
+            return False
+        self.stale_serves += 1
+        global_stats.count("stacked_stale_serves", 1)
+        return True
+
+    def _changed_shards(self, old_gens, gens, shards, rows=1):
+        """Stack row indices whose (uid, generation) drifted, or None
+        when a device patch isn't worthwhile. The cutoff is the static
+        half-the-shards rule (a scatter past it costs about as much as a
+        rebuild) — except under --adaptive on, where the cost model
+        prices upload vs on-device copy bytes (exec/adaptive.decide_patch)
+        and typically patches up to ~7/8 drift."""
         changed = [j for j, (o, n) in enumerate(zip(old_gens, gens))
                    if o != n]
-        if not changed or len(changed) * 2 > len(shards):
+        if not changed:
+            return None
+        if _adaptive.acting():
+            if not _adaptive.decide_patch(len(changed), len(shards), rows,
+                                          WORDS_PER_ROW * 4):
+                return None
+        elif len(changed) * 2 > len(shards):
             return None
         return changed
 
@@ -919,7 +1008,11 @@ class StackedEvaluator:
         if cache:
             stale = self._stale_entry(key, gens)
             if stale is not None:
-                changed = self._changed_shards(stale[0], gens, shards)
+                if self._serve_stale(key, idx.name, field_name, view_name,
+                                     shards, stale, gens):
+                    return stale[1]
+                changed = self._changed_shards(stale[0], gens, shards,
+                                               rows=len(row_chunk))
                 if changed is not None:
                     import jax.numpy as jnp
 
@@ -929,7 +1022,7 @@ class StackedEvaluator:
                     stack = self._place(
                         stale[1].at[:, np.asarray(changed)].set(
                             jnp.asarray(block)), shard_axis=1)
-                    self.patches += 1
+                    self._note_patch("read")
                     self._cache_put(key, gens, stack, stack.size * 4,
                                     stamp)
                     return stack
@@ -967,7 +1060,11 @@ class StackedEvaluator:
             BSI_OFFSET_BIT + i for i in range(depth)]
         stale = self._stale_entry(key, gens)
         if stale is not None:
-            changed = self._changed_shards(stale[0], gens, shards)
+            if self._serve_stale(key, idx.name, field_name, view_name,
+                                 shards, stale, gens):
+                return stale[1]
+            changed = self._changed_shards(stale[0], gens, shards,
+                                           rows=len(rows))
             if changed is not None:
                 import jax.numpy as jnp
 
@@ -982,7 +1079,7 @@ class StackedEvaluator:
                     self._place(exists.at[jdx].set(block[0]),
                                 shard_axis=0),
                 )
-                self.patches += 1
+                self._note_patch("read")
                 self._cache_put(key, gens, arrays, stale[2], stamp)
                 return arrays
         host = self._host_rows(view, rows, shards)
@@ -1964,6 +2061,7 @@ class StackedEvaluator:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "patches": self.patches,
+                "stale_serves": self.stale_serves,
                 "planes_uploaded": self.planes_uploaded,
                 "dispatches": self.dispatches,
                 "pairwise_dispatches": self.pairwise_dispatches,

@@ -54,6 +54,7 @@ from ..shardwidth import SHARD_WIDTH, WORDS_PER_ROW
 __all__ = [
     "BLOCK_WORDS",
     "Container",
+    "OVERLAY_MAX_TERMS",
     "analyze",
     "build",
     "dense_container",
@@ -66,6 +67,9 @@ __all__ = [
     "unflatten",
     "count_program",
     "plane_program",
+    "with_overlay",
+    "overlay_rows",
+    "container_to_dense",
     "fragment_estimate",
     "field_estimate",
     "fragment_ledger",
@@ -108,6 +112,12 @@ AUTO_COMPRESS_FLOOR = int(os.environ.get(
 
 _ARITY = {"dense": 1, "sparse": 2, "rle": 3}
 _MODES = ("auto", "dense", "sparse", "rle")
+
+#: max pending-delta overlay terms a compressed container accumulates
+#: before the ingest merge forces a full rebuild (repr re-chosen from
+#: the measured density). Each term adds a (kind, S, T) program variant
+#: to the jit-key space, so the cap bounds compile churn too.
+OVERLAY_MAX_TERMS = 4
 
 _MODE_LOCK = threading.Lock()
 _MODE = os.environ.get("PILOSA_TPU_CONTAINER_REPR", "auto")
@@ -259,16 +269,25 @@ class Container:
     kind: dense 1, sparse 2, rle 3); `shape` is the logical dense
     [S, W]; `nbytes` the device bytes actually held (what the HBM
     ledger charges); `meta` the chooser's analysis (dense_bytes,
-    density, ratio) for /debug/hbm."""
+    density, ratio) for /debug/hbm.
 
-    __slots__ = ("kind", "shape", "arrays", "nbytes", "meta")
+    `overlay` counts pending-delta overlay terms parked after the base
+    arrays by the streaming ingest merge (exec/ingest.py): each term is
+    an (idx [K] int32, planes [K, W] uint32) pair of full replacement
+    row planes, applied in append order after densifying — so a
+    compressed fragment absorbs write churn without decaying to dense
+    between merges. Dense containers never carry one (their writes
+    scatter in place)."""
 
-    def __init__(self, kind, shape, arrays, nbytes, meta=None):
+    __slots__ = ("kind", "shape", "arrays", "nbytes", "meta", "overlay")
+
+    def __init__(self, kind, shape, arrays, nbytes, meta=None, overlay=0):
         self.kind = kind
         self.shape = tuple(shape)
         self.arrays = tuple(arrays)
         self.nbytes = int(nbytes)
         self.meta = meta or {}
+        self.overlay = int(overlay)
 
     @property
     def csig(self):
@@ -278,9 +297,13 @@ class Container:
         ("dense",) with no logical size — the program reads it off the
         array — so dense containers share fn-cache keys with the legacy
         raw-arity call sites; compressed kinds carry S because their
-        component shapes don't determine it."""
+        component shapes don't determine it, plus the overlay term count
+        when deltas are parked (a different flat arity is a different
+        program)."""
         if self.kind == "dense":
             return ("dense",)
+        if self.overlay:
+            return (self.kind, self.shape[0], self.overlay)
         return (self.kind, self.shape[0])
 
     @property
@@ -312,7 +335,9 @@ def flatten(containers):
 
 
 def flat_arity(csig):
-    return sum(_ARITY[entry[0]] for entry in csig)
+    return sum(_ARITY[entry[0]]
+               + 2 * (entry[2] if len(entry) > 2 else 0)
+               for entry in csig)
 
 
 def norm_csig(csig):
@@ -325,14 +350,24 @@ def norm_csig(csig):
 
 
 def unflatten(csig, flat):
-    """Inverse of flatten inside a traced program: [(kind, arrays, S)]."""
+    """Inverse of flatten inside a traced program: [(kind, arrays, S)],
+    or [(kind, arrays, S, ((oidx, oplanes), ...))] for entries whose
+    csig carries overlay terms (the 3-tuple shape is preserved for
+    overlay-free entries — existing programs and tests index [0]/[2])."""
     out, i = [], 0
     for entry in csig:
         kind = entry[0]
         n = _ARITY[kind]
-        out.append((kind, tuple(flat[i:i + n]),
-                    entry[1] if len(entry) > 1 else -1))
+        cont = (kind, tuple(flat[i:i + n]),
+                entry[1] if len(entry) > 1 else -1)
         i += n
+        terms = entry[2] if len(entry) > 2 else 0
+        if terms:
+            ov = tuple((flat[i + 2 * t], flat[i + 2 * t + 1])
+                       for t in range(terms))
+            i += 2 * terms
+            cont = cont + (ov,)
+        out.append(cont)
     return out
 
 
@@ -624,15 +659,28 @@ def rle_to_dense(run_shard, run_start, run_end, s, w):
     return jax.lax.map(per_shard, jnp.arange(s, dtype=jnp.int32))
 
 
+def _has_overlay(cont):
+    return len(cont) > 3 and cont[3]
+
+
 def to_dense(cont):
     """Dense [S, W] view of an unflattened (kind, arrays, S) container —
-    identity for dense (forced-dense programs ARE the legacy ones)."""
-    kind, arrays, s = cont
+    identity for dense (forced-dense programs ARE the legacy ones).
+    Pending-delta overlay terms scatter in append order after the base
+    densifies: each term's planes are full replacements gathered from
+    the authoritative host fragment, so later terms override earlier."""
+    kind, arrays, s = cont[0], cont[1], cont[2]
     if kind == "dense":
-        return arrays[0]
-    if kind == "sparse":
-        return sparse_to_dense(arrays[0], arrays[1], s, WORDS_PER_ROW)
-    return rle_to_dense(arrays[0], arrays[1], arrays[2], s, WORDS_PER_ROW)
+        dense = arrays[0]
+    elif kind == "sparse":
+        dense = sparse_to_dense(arrays[0], arrays[1], s, WORDS_PER_ROW)
+    else:
+        dense = rle_to_dense(arrays[0], arrays[1], arrays[2], s,
+                             WORDS_PER_ROW)
+    if _has_overlay(cont):
+        for oidx, oplanes in cont[3]:
+            dense = dense.at[oidx].set(oplanes)
+    return dense
 
 
 def _count_container(cont):
@@ -641,13 +689,17 @@ def _count_container(cont):
 
     from . import bitplane
 
-    kind, arrays, _s = cont
-    if kind == "sparse":
-        return sparse_count_hi_lo(*arrays)
-    if kind == "rle":
-        return rle_count_hi_lo(*arrays)
+    kind, arrays = cont[0], cont[1]
+    if not _has_overlay(cont):
+        if kind == "sparse":
+            return sparse_count_hi_lo(*arrays)
+        if kind == "rle":
+            return rle_count_hi_lo(*arrays)
+    # overlay terms replace whole planes, so compressed direct counts
+    # can't subtract what they cover — densify (exact) and count dense
+    acc = to_dense(cont)
     per_shard = jnp.sum(
-        jax.lax.population_count(arrays[0]).astype(jnp.int32), axis=-1)
+        jax.lax.population_count(acc).astype(jnp.int32), axis=-1)
     return bitplane.hi_lo(per_shard)
 
 
@@ -691,7 +743,8 @@ def count_program(sig, csig, flat, tree_eval):
     if sig[0] == "leaf":
         return _count_container(conts[sig[1]])
     leaf_ids = _pure_intersect_leaves(sig)
-    if leaf_ids is not None and len(leaf_ids) >= 2:
+    if (leaf_ids is not None and len(leaf_ids) >= 2
+            and not any(_has_overlay(conts[i]) for i in leaf_ids)):
         kinds = {conts[i][0] for i in leaf_ids}
         if kinds == {"sparse"}:
             first = conts[leaf_ids[0]]
@@ -727,3 +780,43 @@ def plane_program(sig, csig, flat, tree_eval):
     args — filter stacks and Row results must come out as the exact
     legacy planes, so every leaf decompresses in-program first."""
     return tree_eval(sig, [to_dense(c) for c in unflatten(csig, flat)])
+
+
+# -------------------------------------------------------- ingest overlay
+
+
+def with_overlay(cont, place_replicated, oidx, oplanes):
+    """New Container with one more pending-delta overlay term appended
+    after `cont`'s arrays: `oidx` [K] stack-row indices (int32) and
+    `oplanes` [K, W] full replacement planes (uint32), placed replicated
+    like every compressed component. The base representation is
+    untouched — this is how the interval merge folds writes into a
+    sparse/rle fragment without decaying it to dense."""
+    oidx = np.ascontiguousarray(oidx, dtype=np.int32)
+    oplanes = np.ascontiguousarray(oplanes, dtype=np.uint32)
+    arrays = cont.arrays + (place_replicated(oidx),
+                            place_replicated(oplanes))
+    nbytes = cont.nbytes + int(oidx.nbytes + oplanes.nbytes)
+    return Container(cont.kind, cont.shape, arrays, nbytes,
+                     dict(cont.meta), overlay=cont.overlay + 1)
+
+
+def overlay_rows(cont):
+    """Total stack rows covered by a Container's overlay terms (the
+    merge's rebuild-threshold input; counts duplicates across terms)."""
+    base = _ARITY[cont.kind]
+    return sum(int(cont.arrays[base + 2 * t].shape[0])
+               for t in range(cont.overlay))
+
+
+def container_to_dense(cont):
+    """Dense [S, W] of a Container OBJECT (overlay applied) — the
+    eager-mode analogue of the traced to_dense for call sites that hold
+    the Container itself (exec/stacked's read-path decay)."""
+    base = cont.arrays[:_ARITY[cont.kind]]
+    dense = to_dense((cont.kind, base, cont.shape[0]))
+    for t in range(cont.overlay):
+        oidx = cont.arrays[_ARITY[cont.kind] + 2 * t]
+        oplanes = cont.arrays[_ARITY[cont.kind] + 2 * t + 1]
+        dense = dense.at[oidx].set(oplanes)
+    return dense

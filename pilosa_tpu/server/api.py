@@ -399,7 +399,9 @@ class API:
     def __init__(self, holder, cluster=None, client_factory=None,
                  long_query_time=None, logger=None, spmd=None,
                  max_writes_per_request=0, oplog=None,
-                 coalesce_window=0.0, coalesce_max_queue=256):
+                 coalesce_window=0.0, coalesce_max_queue=256,
+                 ingest_interval=0.0, ingest_max_rows=None,
+                 ingest_max_bytes=None):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
@@ -462,6 +464,17 @@ class API:
                 self, self.coalesce_window, self.coalesce_max_queue)
         else:
             self._coalescer = None
+        # Streaming ingest engine (exec/ingest.py): interval 0 — the
+        # default — never constructs one, so the import path is a single
+        # `is None` check and stays byte-identical to the legacy
+        # per-import invalidation.
+        self.ingest = None
+        if float(ingest_interval or 0.0) > 0:
+            from ..exec.ingest import IngestEngine
+
+            self.ingest = IngestEngine(
+                self, float(ingest_interval),
+                max_rows=ingest_max_rows, max_bytes=ingest_max_bytes)
         self._resize_writes = []  # queued (kind, kwargs) during RESIZING
         self._resize_writes_lock = threading.Lock()
         self._resize_draining = False  # replay thread active
@@ -645,6 +658,64 @@ class API:
         counted as dropped): advance the applied watermark."""
         if lsn is not None and self.oplog is not None:
             self.oplog.mark_applied(lsn)
+
+    def _oplog_applied_or_defer(self, lsn):
+        """Like _oplog_applied, but under fsync=interval with the ingest
+        engine active the watermark advance group-commits at the next
+        merge instead of per record (bounded by the oplog's gap set; a
+        crash before the flush replays the records, which is safe —
+        they applied to host fragments idempotently)."""
+        ing = self.ingest
+        if ing is not None and ing.defer_applied(lsn):
+            return
+        self._oplog_applied(lsn)
+
+    # -- streaming ingest (exec/ingest.py) ------------------------------------
+
+    def _ingest_admit(self, rows, nbytes):
+        """503 + Retry-After back-pressure when the delta buffer is past
+        its high-water mark — checked BEFORE the oplog append so a
+        rejected import leaves no record behind."""
+        ing = self.ingest
+        if ing is None:
+            return
+        retry = ing.admit(rows, nbytes)
+        if retry is not None:
+            raise ServiceUnavailableError(
+                "ingest delta buffer full; merge in progress",
+                retry_after=retry)
+
+    def _ingest_record(self, index_name, field, shard_rows, nbytes,
+                       existence=True):
+        """Buffer one applied import's deltas (incl. the index's
+        existence field, which add_existence just wrote — roaring
+        imports skip it, they never touch existence)."""
+        ing = self.ingest
+        if ing is None or not shard_rows:
+            return
+        ing.record(index_name, field, shard_rows, nbytes)
+        if not existence:
+            return
+        idx = self.holder.index(index_name)
+        ef = idx.existence_field() if idx is not None else None
+        if ef is not None and ef is not field:
+            ing.record(index_name, ef, shard_rows, nbytes)
+
+    @staticmethod
+    def _ingest_shard_rows(column_ids):
+        """{shard: landed rows} for the ingest buffer's accounting."""
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        if cols.size == 0:
+            return {}
+        shards, counts = np.unique(cols // np.uint64(SHARD_WIDTH),
+                                   return_counts=True)
+        return {int(s): int(n) for s, n in zip(shards, counts)}
+
+    def ingest_stats(self):
+        """GET /debug/ingest payload ({"enabled": False} when off)."""
+        if self.ingest is None:
+            return {"enabled": False, "interval_seconds": 0.0}
+        return self.ingest.snapshot()
 
     @staticmethod
     def _oplog_encode(kind, kwargs):
@@ -937,10 +1008,14 @@ class API:
         }
 
     def close(self):
-        """Release serving-side background state — currently the query
-        coalescer, whose blocked waiters get a 503 instead of hanging
-        on a daemon thread that dies with the process. Idempotent;
-        window=0 deployments (no coalescer) no-op."""
+        """Release serving-side background state — the ingest merge
+        engine (final flush drains buffered deltas and releases any
+        group-committed oplog watermarks) and the query coalescer,
+        whose blocked waiters get a 503 instead of hanging on a daemon
+        thread that dies with the process. Idempotent; default
+        deployments (no engine, no coalescer) no-op."""
+        if self.ingest is not None:
+            self.ingest.close()
         if self._coalescer is not None:
             self._coalescer.close()
 
@@ -1353,6 +1428,9 @@ class API:
         while RESIZING, so the check stays valid at replay) — a doomed
         import must 404 now, not vanish into a replay-time log line."""
         field = self._field(index_name, field_name)
+        n_points = (len(column_ids) if column_ids is not None
+                    else len(column_keys or ()))
+        self._ingest_admit(n_points, 16 * n_points)
         kwargs = dict(index_name=index_name, field_name=field_name,
                       row_ids=row_ids, column_ids=column_ids,
                       timestamps=timestamps, clear=clear,
@@ -1374,6 +1452,11 @@ class API:
                 changed = field.import_bits(
                     row_ids, column_ids, timestamps=timestamps, clear=clear)
                 self.holder.index(index_name).add_existence(column_ids)
+                if self.ingest is not None:
+                    self._ingest_record(
+                        index_name, field,
+                        self._ingest_shard_rows(column_ids),
+                        16 * len(column_ids))
                 self._broadcast_shards_if_changed(index_name)
                 faultpoints.reached("import.pre-ack")
                 return changed
@@ -1416,6 +1499,12 @@ class API:
                         self.client_factory(n.uri).import_bits(
                             index_name, field_name, r.tolist(), c.tolist(),
                             timestamps=w, clear=clear, remote=True))))
+            if self.ingest is not None and covered:
+                self._ingest_record(
+                    index_name, field,
+                    {s: int((shards == np.uint64(s)).sum())
+                     for s in covered},
+                    16 * len(column_ids))
             _, remote_changed = self._fan_out_writes(
                 jobs, covered, count_shards=remote_only,
                 index_name=index_name)
@@ -1427,7 +1516,7 @@ class API:
             # needs no replay guarantee — mark it applied either way so
             # one failed import can't pin the checkpoint watermark
             # forever (a process crash skips this; that's the point)
-            self._oplog_applied(lsn)
+            self._oplog_applied_or_defer(lsn)
 
     def import_values(self, index_name, field_name, column_ids, values,
                       remote=False, column_keys=None, clear=False):
@@ -1435,6 +1524,9 @@ class API:
         ImportValue with OptImportOptionsClear api.go:1035 ->
         field.importValue field.go:1285)."""
         field = self._field(index_name, field_name)
+        n_points = (len(column_ids) if column_ids is not None
+                    else len(column_keys or ()))
+        self._ingest_admit(n_points, 16 * n_points)
         kwargs = dict(index_name=index_name, field_name=field_name,
                       column_ids=column_ids, values=values,
                       remote=remote, column_keys=column_keys,
@@ -1451,6 +1543,11 @@ class API:
                 changed = field.import_values(column_ids, values, clear=clear)
                 if not clear:
                     self.holder.index(index_name).add_existence(column_ids)
+                if self.ingest is not None:
+                    self._ingest_record(
+                        index_name, field,
+                        self._ingest_shard_rows(column_ids),
+                        16 * len(column_ids), existence=not clear)
                 self._broadcast_shards_if_changed(index_name)
                 faultpoints.reached("import.pre-ack")
                 return changed
@@ -1481,6 +1578,12 @@ class API:
                         self.client_factory(n.uri).import_values(
                             index_name, field_name, c.tolist(), v.tolist(),
                             remote=True, clear=clear))))
+            if self.ingest is not None and covered:
+                self._ingest_record(
+                    index_name, field,
+                    {s: int((shards == np.uint64(s)).sum())
+                     for s in covered},
+                    16 * len(column_ids), existence=not clear)
             _, remote_changed = self._fan_out_writes(
                 jobs, covered, count_shards=remote_only,
                 index_name=index_name)
@@ -1488,7 +1591,7 @@ class API:
             faultpoints.reached("import.pre-ack")
             return changed + remote_changed
         finally:
-            self._oplog_applied(lsn)
+            self._oplog_applied_or_defer(lsn)
 
     def import_roaring(self, index_name, field_name, shard, data,
                        clear=False, view="standard", remote=False):
@@ -1497,6 +1600,7 @@ class API:
         self._validate_state()
         field = self._field(index_name, field_name)
         shard = int(shard)
+        self._ingest_admit(1, len(data))
         lsn = self._oplog_append("roaring", dict(
             index_name=index_name, field_name=field_name, shard=shard,
             data=data, clear=clear, view=view, remote=remote))
@@ -1509,6 +1613,10 @@ class API:
                 v = field.create_view_if_not_exists(view)
                 frag = v.create_fragment_if_not_exists(shard)
                 changed = frag.import_roaring(data, clear=clear)
+                if self.ingest is not None:
+                    self._ingest_record(
+                        index_name, field, {shard: 1}, len(data),
+                        existence=False)
             jobs = [(shard, node, (
                 lambda n=node: self.client_factory(n.uri).import_roaring(
                     index_name, field_name, shard, data, clear=clear,
@@ -1521,7 +1629,7 @@ class API:
             faultpoints.reached("import.pre-ack")
             return changed if local else remote_changed
         finally:
-            self._oplog_applied(lsn)
+            self._oplog_applied_or_defer(lsn)
 
     def _field(self, index_name, field_name):
         idx = self.holder.index(index_name)

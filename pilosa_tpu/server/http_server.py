@@ -170,6 +170,7 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/optimizer", self._get_debug_optimizer),
             Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
+            Route("GET", r"/debug/ingest", self._get_debug_ingest),
             Route("GET", r"/debug/faultpoints", self._get_faultpoints),
             Route("POST", r"/debug/faultpoints", self._post_faultpoints),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
@@ -765,6 +766,8 @@ class PilosaHTTPServer:
                       "burn rates",
         "/debug/oplog": "write-ahead oplog: LSNs, checkpoint, fsync "
                         "policy, segment state",
+        "/debug/ingest": "streaming ingest engine: delta buffer depth, "
+                         "merge counters, deferred oplog watermarks",
         "/debug/flightrecorder": "black-box event ring (dispatches, "
                                  "cache churn, stalls, alerts)",
         "/debug/faultpoints": "fault-injection points (GET state, POST "
@@ -828,6 +831,12 @@ class PilosaHTTPServer:
         out = oplog.summary()
         out["enabled"] = True
         return out
+
+    def _get_debug_ingest(self, req):
+        """Streaming ingest engine state: pending delta-buffer depth
+        (entries/rows/bytes), per-field breakdown, merge/overflow
+        counters, deferred group-commit LSNs (exec/ingest.py)."""
+        return self.api.ingest_stats()
 
     def _get_faultpoints(self, req):
         """Armed fault points + hit counters (crash-test introspection)."""

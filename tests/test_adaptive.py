@@ -412,10 +412,11 @@ def test_debug_optimizer_snapshot_shape(corpus):
     for fam, entry in snap["calibration"]["kernels"].items():
         assert entry["source"] in ("ewma", "cost_analysis", "default")
     assert set(snap["decisions"]) == {
-        "strategy", "tile", "cache", "admission"}
+        "strategy", "tile", "cache", "admission", "patch"}
     json.dumps(snap)  # the /debug/optimizer endpoint serves this as-is
     counts = adaptive.decision_counts()
-    assert set(counts) == {"strategy", "tile", "cache", "admission"}
+    assert set(counts) == {"strategy", "tile", "cache", "admission",
+                           "patch"}
     json.dumps(counts)
 
 
