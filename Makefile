@@ -16,11 +16,11 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
 	test-workload test-batching test-containers test-adaptive \
-	test-ingest lint bench-cpu
+	test-ingest test-admission lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
-	test-containers test-adaptive test-ingest
+	test-containers test-adaptive test-ingest test-admission
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -97,6 +97,13 @@ test-ingest:
 # on==off differential corpus, and /debug/optimizer.
 test-adaptive:
 	$(PY) -m pytest tests/test_adaptive.py $(PYTEST_FLAGS)
+
+# Overload-safe serving surface: request classing + deadline parsing,
+# priced admission (token buckets, bounded queues), the degradation
+# ladder, unified shed rejection (Retry-After + X-Pilosa-Shed), peer
+# overload-vs-unready handling on fan-out, and /debug/admission.
+test-admission:
+	$(PY) -m pytest tests/test_admission.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

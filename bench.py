@@ -106,6 +106,18 @@ def _ingest_mode():
         return None
 
 
+def _admission_mode():
+    """Admission mode ("off" or "on state=<rung>") tagged into every
+    emitted record — a run measured while the degradation ladder was
+    shedding is not comparable to an unloaded one."""
+    try:
+        from pilosa_tpu.server import admission
+
+        return admission.mode()
+    except Exception:
+        return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -322,6 +334,10 @@ def main():
             # streaming ingest engine mode: write-path comparisons must
             # be like-for-like on the delta-buffer policy too
             "ingest_mode": _ingest_mode(),
+            # admission mode + ladder rung: serving comparisons are only
+            # valid between runs under the same QoS policy, and a run
+            # measured while the ladder was shedding is tainted
+            "admission_mode": _admission_mode(),
         },
     }))
 

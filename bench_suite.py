@@ -1962,6 +1962,242 @@ def bench_ingest_qps():
         "disabled_overhead_pct": round(overhead_pct, 4)})
 
 
+def bench_overload():
+    """Overload-safe serving acceptance leg (ISSUE 15).
+
+    Three claims, one JSON line:
+    1. Under a 4x batch flood, interactive goodput (queries finishing
+       inside their latency budget) with --admission on stays >=80% of
+       the unloaded baseline: batch is priced, throttled to its share,
+       and shed with Retry-After instead of camping on the dispatch
+       lock.
+    2. The same flood with --admission off collapses interactive
+       goodput (<50% of baseline): every batch query reaches the
+       dispatch lock and interactive requests queue behind it.
+    3. With --admission off the hooks left on the legacy path (an
+       admission-is-None check per query) cost <2% of one unloaded
+       query, and expired-deadline requests NEVER dispatch.
+    """
+    import tempfile
+    import threading
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import ExecOptions
+    from pilosa_tpu.pql import parse
+    from pilosa_tpu.server import admission as admission_mod
+    from pilosa_tpu.server.api import API, ApiError
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    import jax
+
+    platform = jax.devices()[0].platform
+    n_shards = 4
+    n_rows = 64
+    cols_per_row = 64
+    rng = np.random.default_rng(15)
+    # Concurrent batch producers. Each is a single-minded client that
+    # would consume the whole device alone, but roughly half its cycle
+    # is host-side (parse/plan/decode) outside the dispatch lock — 8
+    # producers offer >=4x the device's serving capacity in locked
+    # device time.
+    n_flood = 8
+    measure_s = 5.0
+    warmup_s = 1.0
+
+    def open_env(tag, **api_kwargs):
+        tmp = tempfile.mkdtemp(prefix=f"pilosa-bench-adm-{tag}-")
+        holder = Holder(tmp).open()
+        holder._bench_tmp = tmp
+        api = API(holder, **api_kwargs)
+        api.create_index("ovl")
+        api.create_field("ovl", "f")
+        for shard in range(n_shards):
+            for row in range(n_rows):
+                c = rng.choice(SHARD_WIDTH, size=cols_per_row,
+                               replace=False)
+                api.import_bits("ovl", "f", [row] * cols_per_row,
+                                (shard * SHARD_WIDTH + c).tolist())
+        return holder, api
+
+    # distinct row pairs per query defeat any result caching; disjoint
+    # ranges per phase keep the three measurements independent
+    pairs = [(a, b) for a in range(n_rows) for b in range(a + 1, n_rows)]
+    rng.shuffle(pairs)
+
+    def interactive_pql(phase, i):
+        a, b = pairs[(phase * 700 + i) % len(pairs)]
+        return f"Count(Union(Row(f={a}), Row(f={b})))"
+
+    flood_pql = "GroupBy(Rows(f))"  # the heavy batch shape
+
+    def run_foreground(api, phase, budget_s, seconds, target_qps):
+        """Paced interactive client offering `target_qps` (an open-loop
+        arrival schedule: a slow reply delays later sends, which IS the
+        collapse). Goodput counts only queries finishing inside their
+        per-request budget."""
+        good = sent = 0
+        t_start = time.perf_counter()
+        t_end = t_start + seconds
+        period = 1.0 / target_qps
+        i = 0
+        while True:
+            due = t_start + i * period
+            now = time.perf_counter()
+            if due > t_end or now > t_end:
+                # schedule exhausted — or the wall overran it (arrivals
+                # the server was too slow to absorb are missed goodput)
+                break
+            if due > now:
+                time.sleep(due - now)
+            pql = interactive_pql(phase, i)
+            i += 1
+            sent += 1
+            t0 = time.perf_counter()
+            try:
+                api.query("ovl", pql,
+                          deadline=time.monotonic() + budget_s,
+                          query_class="interactive")
+                if time.perf_counter() - t0 <= budget_s:
+                    good += 1
+            except ApiError:
+                pass  # 503/504: not goodput
+        return good, sent, seconds
+
+    def flood(api, stop):
+        while not stop.is_set():
+            try:
+                api.query("ovl", flood_pql, query_class="batch")
+            except ApiError as e:
+                # shed: honor a capped Retry-After like a real client
+                time.sleep(min(getattr(e, "retry_after", None) or 0.02,
+                               0.05))
+
+    def overloaded_goodput(api, phase, budget_s, target_qps):
+        stop = threading.Event()
+        threads = [threading.Thread(target=flood, args=(api, stop),
+                                    daemon=True) for _ in range(n_flood)]
+        for t in threads:
+            t.start()
+        time.sleep(warmup_s)  # drain the batch burst, warm calibration
+        good, sent, secs = run_foreground(api, phase, budget_s,
+                                          measure_s, target_qps)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        return good / secs, sent
+
+    # --- unloaded baseline (admission off) ----------------------------
+    holder_off, api_off = open_env("off")
+    api_off.query("ovl", interactive_pql(0, 0))   # warm interactive
+    api_off.query("ovl", flood_pql)               # warm the flood shape
+    lat = []
+    for i in range(100):
+        t0 = time.perf_counter()
+        api_off.query("ovl", interactive_pql(0, i))
+        lat.append(time.perf_counter() - t0)
+    base_p50_s = float(np.percentile(lat, 50))
+    budget_s = max(0.03, 5 * base_p50_s)
+    # the interactive tenant offers ~40% of the device (one serial
+    # dispatch lock = 1000 wall-ms/s): comfortably inside its 60%
+    # admission share, so protection — not rationing — is what's tested
+    target_qps = max(5.0, 0.4 / base_p50_s)
+    good, _sent, secs = run_foreground(api_off, 0, budget_s, 3.0,
+                                       target_qps)
+    base_goodput = good / secs
+
+    # disabled-path overhead: the admission-is-None + deadline-is-None
+    # branches api.query runs per request when the subsystem is off,
+    # priced against one unloaded interactive query
+    assert api_off._admission is None
+    n_probe = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        adm = api_off._admission
+        if adm is not None and not adm.serving_stale():  # pragma: no cover
+            pass
+        api_off.serving_stale()
+    hook_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = hook_ns / 1e9 / base_p50_s * 100
+    assert overhead_pct < 2.0, (
+        f"disabled-path hooks cost {overhead_pct:.3f}% of an unloaded "
+        "query (gate 2%) — admission off is no longer free")
+
+    # admission prices (reported, not load-bearing: the EWMA calibration
+    # reconciles the model against measured wall at runtime)
+    pricer = admission_mod.AdmissionController(logger=None)
+    idx = api_off.holder.index("ovl")
+    ex = getattr(api_off.executor, "local", api_off.executor)
+    cost_i_ms = pricer.price(ex, idx, parse(interactive_pql(0, 3)),
+                             None, ExecOptions())
+    cost_f_ms = pricer.price(ex, idx, parse(flood_pql), None,
+                             ExecOptions())
+    pricer.close()
+    # one serial dispatch lock serves 1000 wall-ms per second — that IS
+    # the device capacity the buckets ration
+    capacity = 1000.0
+
+    # --- 4x flood, admission OFF: collapse ----------------------------
+    off_goodput, off_sent = overloaded_goodput(api_off, 1, budget_s,
+                                               target_qps)
+    _close(holder_off)
+
+    # --- 4x flood, admission ON: interactive protected ----------------
+    holder_on, api_on = open_env(
+        "on", admission="on", admission_capacity=capacity,
+        admission_queue_depth=4, admission_queue_timeout=0.2)
+    api_on.query("ovl", interactive_pql(2, 0))
+    api_on.query("ovl", flood_pql)  # warm the flood shape pre-measure
+    on_goodput, on_sent = overloaded_goodput(api_on, 2, budget_s,
+                                             target_qps)
+
+    # expired-deadline requests never dispatch (checked with the flood
+    # stopped so the stacked counters are quiescent)
+    d0 = getattr(api_on.executor, "local",
+                 api_on.executor)._stacked.counters()[0]
+    expired_504 = 0
+    for i in range(50):
+        try:
+            api_on.query("ovl", interactive_pql(2, 100 + i),
+                         deadline=time.monotonic() - 1.0)
+        except ApiError:
+            expired_504 += 1
+    d1 = getattr(api_on.executor, "local",
+                 api_on.executor)._stacked.counters()[0]
+    assert expired_504 == 50 and d1 == d0, (
+        f"{d1 - d0} expired-deadline requests dispatched (gate 0)")
+    adm_snap = api_on.admission_stats()
+    _close(holder_on)
+
+    on_ratio = on_goodput / base_goodput if base_goodput else 0.0
+    off_ratio = off_goodput / base_goodput if base_goodput else 0.0
+    assert on_ratio >= 0.8, (
+        f"interactive goodput under 4x flood with admission on is only "
+        f"{on_ratio:.2f}x baseline (gate 0.8x)")
+    assert off_ratio < 0.5, (
+        f"admission off kept {off_ratio:.2f}x baseline goodput under "
+        "the 4x flood — the overload scenario is not stressing the "
+        "dispatch lock")
+
+    _emit("overload_goodput", on_goodput, base_goodput, {
+        "platform": platform, "n_shards": n_shards,
+        "flood_threads": n_flood, "budget_ms": round(budget_s * 1000, 1),
+        "offered_interactive_qps": round(target_qps, 1),
+        "baseline_goodput_qps": round(base_goodput, 1),
+        "admission_on_goodput_qps": round(on_goodput, 1),
+        "admission_off_goodput_qps": round(off_goodput, 1),
+        "on_vs_baseline": round(on_ratio, 3),
+        "off_vs_baseline": round(off_ratio, 3),
+        "capacity_ms_per_s": round(capacity, 2),
+        "priced_interactive_ms": round(cost_i_ms, 3),
+        "priced_flood_ms": round(cost_f_ms, 3),
+        "calibration": round(adm_snap.get("calibration", 1.0), 3),
+        "ladder_state": adm_snap.get("state"),
+        "batch_rejected": adm_snap["classes"]["batch"]["rejected"],
+        "batch_admitted": adm_snap["classes"]["batch"]["admitted"],
+        "expired_dispatches": int(d1 - d0),
+        "disabled_hook_ns": round(hook_ns, 1),
+        "disabled_overhead_pct": round(overhead_pct, 4)})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -1979,6 +2215,7 @@ CONFIGS = {
     "compression": bench_compression,
     "adaptive": bench_adaptive,
     "ingest_qps": bench_ingest_qps,
+    "overload": bench_overload,
 }
 
 

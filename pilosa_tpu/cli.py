@@ -226,6 +226,12 @@ def cmd_server(args):
     # legacy apply-then-invalidate write path byte-identical.
     imi = config.get("ingest-merge-interval")
     ingest_interval = parse_duration(str(imi)) if imi else 0.0
+    # Admission control (QoS): off — the default — keeps the legacy
+    # uncontrolled serving path byte-identical.
+    admission = str(config.get("admission", "off")).lower()
+    adm_cap = config.get("admission-capacity")
+    adm_qd = config.get("admission-queue-depth")
+    adm_qt = config.get("admission-queue-timeout")
     spmd = None
     if spmd_requested and cluster is not None:
         from .cluster.spmd import SpmdDataPlane
@@ -241,7 +247,12 @@ def cmd_server(args):
               spmd=spmd, oplog=oplog,
               coalesce_window=coalesce_window,
               coalesce_max_queue=coalesce_max_queue,
-              ingest_interval=ingest_interval)
+              ingest_interval=ingest_interval,
+              admission=admission,
+              admission_capacity=float(adm_cap) if adm_cap else None,
+              admission_queue_depth=int(adm_qd) if adm_qd else None,
+              admission_queue_timeout=parse_duration(str(adm_qt))
+              if adm_qt else None)
     anti_entropy = None
     translate_repl = None
     if cluster is not None:  # even single-node: the cluster can grow
@@ -812,7 +823,9 @@ def _apply_server_flags(config, args):
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
                  "coalesce_window", "coalesce_max_queue",
-                 "container_repr", "adaptive", "ingest_merge_interval"):
+                 "container_repr", "adaptive", "ingest_merge_interval",
+                 "admission", "admission_capacity",
+                 "admission_queue_depth", "admission_queue_timeout"):
         val = getattr(args, flag, None)
         if val is not None:
             config[flag.replace("_", "-")] = val
@@ -1044,6 +1057,26 @@ def main(argv=None):
                         "interval; reads serve the pre-merge snapshot "
                         "meanwhile (default 0 = disabled, legacy "
                         "apply-then-invalidate path)")
+    p.add_argument("--admission", default=None,
+                   choices=["off", "on"],
+                   help="cost-aware admission control + degradation "
+                        "ladder: classifies queries (X-Query-Class / "
+                        "PQL shape), prices them through the EXPLAIN "
+                        "cost model, debits per-class token buckets, "
+                        "queues bounded past capacity, and degrades "
+                        "NORMAL→SHED_BATCH→STALE_OK→LIFEBOAT on SLO "
+                        "burn / device health; off (default) keeps the "
+                        "legacy uncontrolled serving path byte-identical")
+    p.add_argument("--admission-capacity", type=float, default=None,
+                   help="admission token refill rate in device-ms per "
+                        "second (default 1000 = one device's worth); "
+                        "split interactive/batch/internal 60/30/10")
+    p.add_argument("--admission-queue-depth", type=int, default=None,
+                   help="bounded admission queue per class: past it, "
+                        "queries get 503 + Retry-After (default 64)")
+    p.add_argument("--admission-queue-timeout", default=None,
+                   help="max time a query waits for admission tokens "
+                        "before 503 (e.g. 5s; default 5s)")
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"],
                    help="durability fsync policy for the write-ahead "

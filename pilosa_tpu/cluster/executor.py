@@ -234,7 +234,14 @@ class ClusterExecutor:
         try:
             plan_calls = [] if explain == "analyze" else None
             results = []
+            deadline = getattr(opt, "deadline", None)
             for call in query.calls:
+                if deadline is not None \
+                        and _time.monotonic() >= deadline:
+                    from ..exec.stacked import DeadlineExceededError
+
+                    raise DeadlineExceededError(
+                        "request deadline expired between calls")
                 if plan_calls is None:
                     results.append(self._execute_call(idx, call, shards, opt))
                     continue
@@ -356,7 +363,8 @@ class ClusterExecutor:
             exclude_columns=opt.exclude_columns,
             column_attrs=opt.column_attrs,
             exclude_row_attrs=opt.exclude_row_attrs,
-            remote=True, profile=opt.profile)
+            remote=True, profile=opt.profile,
+            deadline=getattr(opt, "deadline", None))
 
     def _execute_replicated_write(self, idx, call):
         """Set/Clear: apply on every replica of the owning shard
@@ -432,6 +440,8 @@ class ClusterExecutor:
         merged = [None]
         merged_any = [False]
         errors = []
+        overload_retried = set()  # node ids given their one same-node retry
+        deadline = getattr(opt, "deadline", None)
 
         def merge_in(result):
             with lock:
@@ -451,15 +461,37 @@ class ClusterExecutor:
                                   "plan": sub_plan})
 
         def run_node(node, node_shards, tried=()):
+            from ..exec.stacked import (DeadlineExceededError,
+                                        set_thread_deadline)
+
             try:
+                # Deadline at leg start: an expired leg is dropped, never
+                # dispatched — locally OR on a peer. Remaining budget is
+                # forwarded RELATIVE (the peer's edge re-anchors against
+                # its own clock; clock skew never corrupts it).
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise DeadlineExceededError(
+                            "request deadline expired before fan-out leg")
                 if node.id == self.cluster.local_id:
-                    if plan_sink is not None:
-                        result, pnode = self.local.explain_analyze_call(
-                            idx, call, node_shards, self._remote_opt(opt))
-                        note_plan(node, node_shards, pnode.to_dict())
-                    else:
-                        result = self.local.execute_call(
-                            idx, call, node_shards, self._remote_opt(opt))
+                    # local legs run execute_call on a pool thread — the
+                    # coordinator's thread-local dispatch deadline doesn't
+                    # travel here, so arm this thread's own
+                    if deadline is not None:
+                        set_thread_deadline(deadline)
+                    try:
+                        if plan_sink is not None:
+                            result, pnode = self.local.explain_analyze_call(
+                                idx, call, node_shards, self._remote_opt(opt))
+                            note_plan(node, node_shards, pnode.to_dict())
+                        else:
+                            result = self.local.execute_call(
+                                idx, call, node_shards, self._remote_opt(opt))
+                    finally:
+                        if deadline is not None:
+                            set_thread_deadline(None)
                 elif plan_sink is not None:
                     # analyze legs ride the JSON wire regardless of the
                     # configured internal encoding: the proto response has
@@ -468,7 +500,7 @@ class ClusterExecutor:
                         idx.name, pql, shards=node_shards, remote=True,
                         exclude_row_attrs=opt.exclude_row_attrs,
                         exclude_columns=opt.exclude_columns,
-                        explain="analyze")
+                        explain="analyze", deadline=remaining)
                     result = result_from_json(resp["results"][0])
                     sub = resp.get("plan") or {}
                     calls = sub.get("calls") or [None]
@@ -480,7 +512,8 @@ class ClusterExecutor:
                     results, err = self._client(node).query_proto(
                         idx.name, pql, shards=node_shards, remote=True,
                         exclude_row_attrs=opt.exclude_row_attrs,
-                        exclude_columns=opt.exclude_columns)
+                        exclude_columns=opt.exclude_columns,
+                        deadline=remaining)
                     if err:
                         raise ClusterExecError(err)
                     if not results:
@@ -496,20 +529,51 @@ class ClusterExecutor:
                     resp = self._client(node).query(
                         idx.name, pql, shards=node_shards, remote=True,
                         exclude_row_attrs=opt.exclude_row_attrs,
-                        exclude_columns=opt.exclude_columns)
+                        exclude_columns=opt.exclude_columns,
+                        deadline=remaining)
                     result = result_from_json(resp["results"][0])
                 merge_in(result)
             except Exception as e:
-                if getattr(e, "status", None) == 503:
-                    # the peer REJECTED fast (its device-link prober says
-                    # DOWN) rather than timing out — name the node in the
-                    # recorder so a cluster slowdown is attributable (the
-                    # coordinator's /status?observability=true roll-up
-                    # shows the same state via /debug/device)
-                    from ..utils import flightrec
+                from ..server.client import DeadlineExceeded
+                from ..utils import flightrec
 
-                    flightrec.record("cluster.node_unready", node=node.id,
-                                     index=idx.name, error=str(e))
+                if isinstance(e, (DeadlineExceededError, DeadlineExceeded)) \
+                        or getattr(e, "status", None) == 504:
+                    # every replica shares the same lapsed deadline —
+                    # retrying is pure waste, drop the leg
+                    with lock:
+                        errors.append((node.id, e))
+                    return
+                if getattr(e, "status", None) == 503:
+                    shed = getattr(e, "shed", None)
+                    if shed is not None:
+                        # the peer is SHEDDING (X-Pilosa-Shed: admission /
+                        # coalesce / ingest back-pressure), not dead:
+                        # honor its Retry-After (capped — a fan-out leg
+                        # can't idle for seconds) and retry the SAME
+                        # replica once before moving on
+                        with lock:
+                            first = node.id not in overload_retried
+                            overload_retried.add(node.id)
+                        flightrec.record(
+                            "cluster.node_overload", node=node.id,
+                            index=idx.name, site=shed,
+                            retry_after=getattr(e, "retry_after", None))
+                        if first:
+                            _time.sleep(min(
+                                getattr(e, "retry_after", None) or 0.05,
+                                0.5))
+                            return run_node(node, node_shards, tried)
+                    else:
+                        # the peer REJECTED fast (its device-link prober
+                        # says DOWN) rather than timing out — name the
+                        # node in the recorder so a cluster slowdown is
+                        # attributable (the coordinator's
+                        # /status?observability=true roll-up shows the
+                        # same state via /debug/device)
+                        flightrec.record(
+                            "cluster.node_unready", node=node.id,
+                            index=idx.name, error=str(e))
                 # retry each shard on its next replica (reference:
                 # mapReduce error path executor.go:2490-2503)
                 retried = False
@@ -554,6 +618,17 @@ class ClusterExecutor:
             lambda item: run_node_traced(*item), list(by_node.items()))
 
         if errors:
+            from ..exec.stacked import DeadlineExceededError
+            from ..server.client import DeadlineExceeded
+
+            for _nid, e in errors:
+                if isinstance(e, DeadlineExceededError):
+                    raise e
+                if isinstance(e, DeadlineExceeded) \
+                        or getattr(e, "status", None) == 504:
+                    # a remote leg's budget lapsed (client-side or the
+                    # peer's own 504) — same 504 at the coordinator
+                    raise DeadlineExceededError(str(e)) from e
             raise ClusterExecError(f"query failed: {errors}")
         if not merged_any[0]:
             # zero shards anywhere: run locally over an empty shard list so

@@ -51,7 +51,7 @@ class FieldNotFound(ExecError):
 class ExecOptions:
     def __init__(self, shards=None, exclude_columns=False,
                  column_attrs=False, exclude_row_attrs=False, remote=False,
-                 profile=False, explain=None):
+                 profile=False, explain=None, deadline=None):
         self.shards = shards
         self.exclude_columns = exclude_columns
         self.column_attrs = column_attrs
@@ -62,6 +62,9 @@ class ExecOptions:
         # tree, execute NOTHING), or "analyze" (?explain=analyze: execute
         # and graft actual costs onto the plan) — see exec/plan.py
         self.explain = explain
+        # absolute time.monotonic() instant after which remaining work
+        # is dropped (checked per call and per dispatch), or None
+        self.deadline = deadline
 
 
 def uint_arg(call, key):
@@ -165,7 +168,8 @@ def unwrap_options(call, opt):
             column_attrs=opt.column_attrs,
             exclude_row_attrs=opt.exclude_row_attrs,
             remote=opt.remote, profile=opt.profile,
-            explain=getattr(opt, "explain", None))
+            explain=getattr(opt, "explain", None),
+            deadline=getattr(opt, "deadline", None))
         for key, value in call.args.items():
             if key == "excludeColumns":
                 merged.exclude_columns = bool(value)
@@ -290,12 +294,25 @@ class Executor:
         plan_nodes = [] if explain == "analyze" else None
         results = []
         t_query = _time.perf_counter()
+        # Deadline propagation: arm the dispatch-boundary thread-local
+        # for this query (stacked._locked_dispatch refuses expired work
+        # before taking the lock) and check between top-level calls so a
+        # multi-call query stops at the first lapsed boundary. None →
+        # both checks are no-ops (legacy path).
+        from .stacked import DeadlineExceededError, set_thread_deadline
+        deadline = getattr(opt, "deadline", None)
+        if deadline is not None:
+            set_thread_deadline(deadline)
         try:
             with tracing.start_span(
                     "executor.Execute", index=index_name) as span:
                 from . import adaptive as adaptive_mod
 
                 for call in query.calls:
+                    if deadline is not None \
+                            and _time.monotonic() >= deadline:
+                        raise DeadlineExceededError(
+                            "request deadline expired between calls")
                     t_call = _time.perf_counter()
                     self._explain_tls.last = None
                     with tracing.start_span(
@@ -342,6 +359,8 @@ class Executor:
                           - before["planes_uploaded"])
                          * WORDS_PER_ROW * 4)
         finally:
+            if deadline is not None:
+                set_thread_deadline(None)
             # even a failed query records its shape — a recurring error
             # shape is exactly what the workload view should surface
             if wctx is not None:
@@ -1975,7 +1994,8 @@ class Executor:
             column_attrs=opt.column_attrs,
             exclude_row_attrs=opt.exclude_row_attrs,
             remote=opt.remote, profile=opt.profile,
-            explain=getattr(opt, "explain", None))
+            explain=getattr(opt, "explain", None),
+            deadline=getattr(opt, "deadline", None))
         for key, value in call.args.items():
             if key == "shards":
                 if not isinstance(value, list):

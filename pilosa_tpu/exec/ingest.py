@@ -162,8 +162,15 @@ class IngestEngine:
         self.rebuilt_entries = 0
         self.dropped_entries = 0
         self.overflows = 0
+        self.merges_shed = 0
         self.group_commit_flushed = 0
         self.last_merge = None  # {wall_seconds, at, entries, deltas}
+        # admission-ladder hook: when set and truthy at a TIMER tick,
+        # the interval merge is skipped (deltas keep buffering; reads
+        # serve the resident — stale — stacks). Overflow wakes always
+        # merge: shedding those would deadlock the write path behind
+        # its own back-pressure gate.
+        self._shed_probe = None
         with _REGISTRY_LOCK:
             _REGISTRY.append(self)
         self._thread = threading.Thread(
@@ -171,6 +178,11 @@ class IngestEngine:
         self._thread.start()
 
     # -- write-path hooks (called by server/api.py) ---------------------------
+
+    def set_shed_probe(self, fn):
+        """Install the admission ladder's merge-shed predicate (called
+        once at API construction; None clears)."""
+        self._shed_probe = fn
 
     def admit(self, rows, nbytes):
         """Back-pressure gate BEFORE the oplog append: returns a
@@ -279,10 +291,22 @@ class IngestEngine:
 
     def _loop(self):
         while True:
-            self._wake.wait(self.interval)
+            forced = self._wake.wait(self.interval)
             self._wake.clear()
             if self._stop.is_set():
                 return
+            probe = self._shed_probe
+            if not forced and probe is not None and probe():
+                # SHED_BATCH+: skip the interval merge to keep the
+                # device free for interactive reads. Deltas stay
+                # buffered; an overflow (forced wake) still merges.
+                with self._plock:
+                    self.merges_shed += 1
+                    pending = bool(self._pending or self._deferred)
+                if pending:
+                    _flightrec.record("ingest.merge_shed",
+                                      rows=self._rows, bytes=self._bytes)
+                continue
             try:
                 self.flush()
             except Exception as exc:  # noqa: BLE001 — keep merging
@@ -526,6 +550,7 @@ class IngestEngine:
                 "rebuilt_entries": self.rebuilt_entries,
                 "dropped_entries": self.dropped_entries,
                 "overflows": self.overflows,
+                "merges_shed": self.merges_shed,
                 "group_commit_flushed": self.group_commit_flushed,
                 "last_merge": last,
             }

@@ -138,6 +138,32 @@ class GroupCommit:
 #: StackedEvaluator.__init__ for the rendezvous-starvation rationale)
 _DISPATCH_LOCK = threading.Lock()
 
+
+class DeadlineExceededError(Exception):
+    """The request's deadline lapsed mid-query — raised at the dispatch
+    boundary BEFORE the device launch, so expired work never holds the
+    dispatch lock. Defined here (not exec/executor.py) so the per-
+    dispatch check needs no circular import; server/api.py maps it to
+    504."""
+
+
+_deadline_tls = threading.local()
+
+
+def set_thread_deadline(at):
+    """Arm (or with None, clear) this thread's request deadline — an
+    absolute time.monotonic() instant. Checked by _locked_dispatch
+    before each lock acquisition; the executor sets it around each
+    query's call loop."""
+    _deadline_tls.at = at
+
+
+def _check_thread_deadline():
+    at = getattr(_deadline_tls, "at", None)
+    if at is not None and time.monotonic() >= at:
+        raise DeadlineExceededError(
+            "request deadline expired before dispatch")
+
 _SERIAL_EXECUTION = None
 
 
@@ -1204,6 +1230,7 @@ class StackedEvaluator:
         actuals). `fn` — when it is a _wrap_spec_capture kernel — lets
         the clock detect a first call (its key absent from the arg-spec
         cache) and relabel dispatch_ack as compile."""
+        _check_thread_deadline()
         prof = _profile.current()
         _flightrec.record("dispatch.start", kernel=kind)
         token = _flightrec.watch_begin("dispatch." + kind)

@@ -10,6 +10,7 @@ import array
 import base64
 import io
 import csv
+import random
 import threading
 import time
 
@@ -57,6 +58,33 @@ class ServiceUnavailableError(ApiError):
         if retry_after is not None:
             self.headers = {
                 "Retry-After": str(max(1, int(round(retry_after))))}
+
+
+class GatewayTimeoutError(ApiError):
+    """504: the request's `X-Request-Deadline` lapsed before (or
+    between) dispatches — the work was dropped, never executed, so the
+    client should treat it as not-done rather than ambiguous."""
+    status = 504
+
+
+def shed_reject(site, message, retry_after, qclass=None):
+    """THE 503 rejection path for every load-shedding site — coalescer
+    overflow, ingest back-pressure, resize-queue overflow, admission.
+    One shared `rejections_total{site,class}` counter, one jitter rule
+    (x1.0-1.25, so a thundering herd of synchronized client retries
+    decorrelates — the same reason server/client.py jitters its
+    backoff), and the `X-Pilosa-Shed` marker header that lets a
+    cluster coordinator tell a *shedding* peer from a *dead* one
+    (cluster/executor.py honors it with a same-replica retry instead
+    of the node_unready path)."""
+    from ..utils.stats import global_stats
+
+    global_stats.count("rejections_total", 1,
+                       {"site": site, "class": qclass or "none"})
+    ra = max(1.0, float(retry_after)) * random.uniform(1.0, 1.25)
+    err = ServiceUnavailableError(message, retry_after=ra)
+    err.headers["X-Pilosa-Shed"] = site
+    raise err
 
 
 #: oplog binary-list type codes -> array.array typecodes ('I' is only
@@ -210,9 +238,11 @@ class QueryCoalescer:
             if len(self._queue) >= self.max_queue:
                 self.rejected += 1
                 global_stats.count("batch_rejected_total", 1)
-                raise ServiceUnavailableError(
+                shed_reject(
+                    "coalesce",
                     f"coalesce queue full ({self.max_queue}); shed load "
-                    "or raise --coalesce-max-queue", retry_after=1)
+                    "or raise --coalesce-max-queue", 1,
+                    qclass="interactive")
             self._queue.append(m)
             if self._thread is None:
                 self._start_thread_locked()
@@ -401,7 +431,9 @@ class API:
                  max_writes_per_request=0, oplog=None,
                  coalesce_window=0.0, coalesce_max_queue=256,
                  ingest_interval=0.0, ingest_max_rows=None,
-                 ingest_max_bytes=None):
+                 ingest_max_bytes=None, admission="off",
+                 admission_capacity=None, admission_queue_depth=None,
+                 admission_queue_timeout=None):
         from ..cluster import ClusterExecutor
         from ..utils.logger import StandardLogger
 
@@ -475,6 +507,29 @@ class API:
             self.ingest = IngestEngine(
                 self, float(ingest_interval),
                 max_rows=ingest_max_rows, max_bytes=ingest_max_bytes)
+        # Admission control + degradation ladder (server/admission.py):
+        # "off" — the default — never constructs a controller, so the
+        # query path's only residue is one `is None` check and the
+        # legacy path stays byte-identical (escape-hatch convention).
+        if admission not in ("off", "on"):
+            raise ValueError(
+                f"admission must be on|off, got {admission!r}")
+        self._admission = None
+        if admission == "on":
+            from . import admission as admission_mod
+
+            self._admission = admission_mod.AdmissionController(
+                capacity_ms_per_s=admission_capacity,
+                queue_depth=admission_mod.DEFAULT_QUEUE_DEPTH
+                if admission_queue_depth is None else admission_queue_depth,
+                queue_timeout=admission_mod.DEFAULT_QUEUE_TIMEOUT
+                if admission_queue_timeout is None
+                else admission_queue_timeout,
+                logger=self.logger)
+            if self.ingest is not None:
+                # degradation-ladder shed policy for interval merges
+                # (overflow-forced merges still run)
+                self.ingest.set_shed_probe(self._admission.shed_merges)
         self._resize_writes = []  # queued (kind, kwargs) during RESIZING
         self._resize_writes_lock = threading.Lock()
         self._resize_draining = False  # replay thread active
@@ -551,10 +606,11 @@ class API:
                 # record is marked applied — a 503 promises nothing, and
                 # an eternally-unapplied lsn would pin the checkpoint.
                 self._oplog_applied(lsn)
-                raise ServiceUnavailableError(
+                shed_reject(
+                    "resize_queue",
                     "cluster is resizing; try again later "
                     "(write queue full)",
-                    retry_after=self.RESIZE_QUEUE_RETRY_AFTER)
+                    self.RESIZE_QUEUE_RETRY_AFTER, qclass="batch")
             self._resize_writes.append((kind, kwargs, lsn))
         return True
 
@@ -681,9 +737,10 @@ class API:
             return
         retry = ing.admit(rows, nbytes)
         if retry is not None:
-            raise ServiceUnavailableError(
+            shed_reject(
+                "ingest",
                 "ingest delta buffer full; merge in progress",
-                retry_after=retry)
+                retry, qclass="batch")
 
     def _ingest_record(self, index_name, field, shard_rows, nbytes,
                        existence=True):
@@ -834,8 +891,16 @@ class API:
         threading.Thread(target=run, daemon=True,
                          name="oplog-checkpoint").start()
 
-    def query(self, index_name, pql, shards=None, options=None):
-        """(reference: api.Query api.go:135)"""
+    def query(self, index_name, pql, shards=None, options=None,
+              deadline=None, query_class=None):
+        """(reference: api.Query api.go:135)
+
+        `deadline` — absolute time.monotonic() instant parsed from
+        `X-Request-Deadline` at the HTTP edge (None = unbounded);
+        checked here, at admission queue pop, before each dispatch,
+        and forwarded on cluster fan-out. `query_class` — the
+        validated `X-Query-Class` header value (None = classify from
+        PQL shape)."""
         import contextlib
 
         from ..utils import flightrec
@@ -845,6 +910,14 @@ class API:
         self._validate_state()
         if self.holder.index(index_name) is None:
             raise NotFoundError(f"index not found: {index_name}")
+        # Expired-on-arrival: drop BEFORE any dispatch can start — the
+        # client already gave up, so executing is pure waste (stacked
+        # dispatch counters stay flat; tests pin this).
+        if deadline is not None and time.monotonic() >= deadline:
+            flightrec.record("query.rejected", index=index_name,
+                             reason="deadline_expired")
+            raise GatewayTimeoutError(
+                "request deadline expired before execution")
         # Device-link fail-fast: with the link DOWN a query would wedge
         # behind the dispatch lock until the watchdog fires (75s+ in the
         # r04/r05 postmortems); reject in microseconds instead. DEGRADED
@@ -859,6 +932,70 @@ class API:
             raise ServiceUnavailableError(
                 "device link DOWN (canary probes failing); "
                 f"retry in {retry:.0f}s", retry_after=retry)
+        # Admission gate (server/admission.py): classify, price via the
+        # cost model (zero dispatches), debit the class's token bucket —
+        # queueing bounded-FIFO in front of the dispatch lock when dry,
+        # shedding with 503 + Retry-After past the bound. Remote fan-out
+        # legs are NOT re-admitted: the coordinator already paid for the
+        # whole query, and double-charging would halve effective
+        # capacity (the deadline still rides `options` end-to-end).
+        ticket = None
+        adm = self._admission
+        if adm is not None and not (options is not None
+                                    and options.remote):
+            ticket = self._admit_query(
+                adm, index_name, pql, shards, options, deadline,
+                query_class)
+        if deadline is not None:
+            options = options or ExecOptions()
+            options.deadline = deadline
+        t_admitted = time.monotonic()
+        try:
+            return self._query_admitted(
+                index_name, pql, shards, options, deadline)
+        finally:
+            if ticket is not None:
+                adm.note_done(ticket, time.monotonic() - t_admitted)
+
+    def _admit_query(self, adm, index_name, pql, shards, options,
+                     deadline, query_class):
+        """Price + admit one query; translates the controller's
+        exceptions onto the unified rejection paths. Parse errors fall
+        through un-admitted so the legacy path reports them as the
+        usual 400."""
+        from ..utils import flightrec
+        from . import admission as admission_mod
+
+        try:
+            parsed = parse(pql) if isinstance(pql, str) else pql
+        except Exception:  # noqa: BLE001 — legacy 400 path owns this
+            return None
+        qclass = admission_mod.classify(header=query_class, query=parsed)
+        is_write = any(c.writes() for c in parsed.calls)
+        cost_ms = adm.price(self.executor, self.holder.index(index_name),
+                            parsed, shards, options or ExecOptions())
+        try:
+            return adm.admit(qclass, cost_ms, deadline=deadline,
+                             is_write=is_write)
+        except admission_mod.Expired as e:
+            flightrec.record("query.rejected", index=index_name,
+                             reason="deadline_expired_in_queue")
+            raise GatewayTimeoutError(str(e)) from e
+        except admission_mod.Rejected as e:
+            flightrec.record("query.rejected", index=index_name,
+                             reason="admission", qclass=e.qclass,
+                             state=adm.state)
+            shed_reject("admission", str(e), e.retry_after,
+                        qclass=e.qclass)
+
+    def _query_admitted(self, index_name, pql, shards, options,
+                        deadline=None):
+        """The pre-admission body of query() — unchanged legacy path."""
+        import contextlib
+
+        from ..utils import flightrec
+        from ..utils import profile as profile_mod
+        from ..utils import tracing
         # Coalescer routing: batchable single-call reads with default
         # options fuse with concurrent arrivals into one vmapped
         # dispatch. Ineligible queries (and window=0 deployments, where
@@ -899,6 +1036,11 @@ class API:
         except (ApiError,):
             raise
         except Exception as e:
+            from ..exec.stacked import DeadlineExceededError
+            if isinstance(e, DeadlineExceededError):
+                flightrec.record("query.rejected", index=index_name,
+                                 reason="deadline_expired_mid_query")
+                raise GatewayTimeoutError(str(e)) from e
             raise ApiError(str(e)) from e
         finally:
             flightrec.watch_end(wtoken)
@@ -930,7 +1072,8 @@ class API:
         if o is not None and (o.remote or o.profile or o.explain
                               or o.column_attrs or o.exclude_columns
                               or o.exclude_row_attrs
-                              or o.shards is not None):
+                              or o.shards is not None
+                              or getattr(o, "deadline", None) is not None):
             return None
         try:
             query = parse(pql)
@@ -1007,6 +1150,21 @@ class API:
             "batched_queries": st.get("batched_queries", 0),
         }
 
+    def admission_stats(self):
+        """GET /debug/admission: the controller's full snapshot —
+        ladder state + transition history, per-class token buckets and
+        queue occupancy, calibration factor (off → {"enabled": False},
+        matching the other gated subsystems' debug payloads)."""
+        if self._admission is None:
+            return {"enabled": False}
+        return self._admission.snapshot()
+
+    def serving_stale(self):
+        """True when the degradation ladder is at STALE_OK or worse —
+        the HTTP layer marks query responses with "stale": true so
+        clients know reads may lag the ingest staleness bound."""
+        return self._admission is not None and self._admission.serving_stale()
+
     def close(self):
         """Release serving-side background state — the ingest merge
         engine (final flush drains buffered deltas and releases any
@@ -1014,6 +1172,8 @@ class API:
         whose blocked waiters get a 503 instead of hanging on a daemon
         thread that dies with the process. Idempotent; default
         deployments (no engine, no coalescer) no-op."""
+        if self._admission is not None:
+            self._admission.close()
         if self.ingest is not None:
             self.ingest.close()
         if self._coalescer is not None:
@@ -1760,6 +1920,8 @@ class API:
             "heat": workload_mod.heat().summary(),
             "slo": workload_mod.slo().summary(),
         }
+        if self._admission is not None:
+            out["admission"] = self._admission.summary()
         if self.oplog is not None:
             out["oplog"] = self.oplog.summary(compact=True)
         return out
@@ -1828,6 +1990,11 @@ class API:
                              for o in sl.get("objectives") or []
                              if o.get("alerting")],
                 "alerts_total": sl.get("alerts_total")}
+            adm = client.debug_admission()
+            if adm.get("enabled"):
+                out["admission"] = {k: adm.get(k) for k in
+                                    ("state", "state_age_seconds",
+                                     "calibration")}
             return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}

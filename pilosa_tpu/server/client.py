@@ -65,10 +65,12 @@ class Client:
 
     def _request(self, method, path, body=None,
                  content_type="application/json", idempotent=None,
-                 deadline=None):
+                 deadline=None, headers=None):
         """idempotent: may network-level failures be retried? (an HTTP
         503 is retried regardless — the server rejected the request
-        before doing work). Defaults to True for GET/DELETE."""
+        before doing work). Defaults to True for GET/DELETE.
+        headers: extra request headers sent on every attempt (e.g. the
+        forwarded X-Request-Deadline on cluster fan-out)."""
         if idempotent is None:
             idempotent = method in ("GET", "DELETE")
         if deadline is None:
@@ -80,7 +82,7 @@ class Client:
             retry_after = None
             try:
                 return self._request_once(method, path, body, content_type,
-                                          deadline_at)
+                                          deadline_at, headers)
             except ClientError as e:
                 if e.status != 503 or attempt >= self.retries:
                     raise
@@ -105,7 +107,8 @@ class Client:
             time.sleep(delay)
             attempt += 1
 
-    def _request_once(self, method, path, body, content_type, deadline_at):
+    def _request_once(self, method, path, body, content_type, deadline_at,
+                      headers=None):
         from ..utils import tracing
 
         timeout = self.timeout
@@ -119,6 +122,9 @@ class Client:
             self.base_url + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        if headers:
+            for k, v in headers.items():
+                req.add_header(k, v)
         for k, v in tracing.inject_headers().items():
             req.add_header(k, v)  # cross-node trace context (client inject)
         try:
@@ -139,6 +145,12 @@ class Client:
                     err.retry_after = float(ra)
                 except ValueError:
                     pass
+            # which shedding site rejected us (admission, coalesce,
+            # ingest, resize_queue) — lets the cluster layer tell an
+            # OVERLOADED peer from an unready/dead one
+            shed = e.headers.get("X-Pilosa-Shed") if e.headers else None
+            if shed is not None:
+                err.shed = shed
             raise err from e
         if ctype.startswith("application/json"):
             return json.loads(data.decode()) if data else None
@@ -167,11 +179,26 @@ class Client:
 
     # -- queries -------------------------------------------------------------
 
+    @staticmethod
+    def _query_headers(deadline, query_class):
+        """X-Request-Deadline / X-Query-Class headers (None when
+        neither is set). `deadline` is a RELATIVE budget in seconds —
+        the receiving edge re-anchors it against its own clock, so
+        coordinator/peer clock skew never corrupts the deadline."""
+        headers = {}
+        if deadline is not None:
+            headers["X-Request-Deadline"] = f"{float(deadline):.6f}"
+        if query_class is not None:
+            headers["X-Query-Class"] = query_class
+        return headers or None
+
     def query_proto(self, index, pql, shards=None, remote=False,
-                    exclude_row_attrs=False, exclude_columns=False):
+                    exclude_row_attrs=False, exclude_columns=False,
+                    deadline=None, query_class=None):
         """Query over the protobuf data plane (reference:
         InternalClient.QueryNode posts proto QueryRequests). Returns
-        (results, err)."""
+        (results, err). deadline: remaining budget in seconds, sent as
+        X-Request-Deadline AND bounding local retries."""
         from .. import encoding
 
         body = encoding.encode_query_request(
@@ -180,18 +207,23 @@ class Client:
             exclude_columns=exclude_columns)
         data = self._request(
             "POST", f"/index/{index}/query", body,
-            content_type=encoding.CONTENT_TYPE_PROTOBUF)
+            content_type=encoding.CONTENT_TYPE_PROTOBUF,
+            deadline=deadline,
+            headers=self._query_headers(deadline, query_class))
         return encoding.decode_query_response(data)
 
     def query(self, index, pql, shards=None, remote=False,
               exclude_row_attrs=False, exclude_columns=False,
-              profile=False, explain=None):
+              profile=False, explain=None, deadline=None,
+              query_class=None):
         """(reference: InternalClient.QueryNode http/client.go:268; remote
         marks node-to-node fan-out requests that must not re-fan-out;
         profile asks the server to return the query's span-tree profile
         alongside the results; explain="plan" returns the annotated plan
         WITHOUT executing, explain="analyze" executes and returns the
-        plan with actual costs grafted on)"""
+        plan with actual costs grafted on; deadline: remaining budget in
+        seconds, sent as X-Request-Deadline and bounding local retries;
+        query_class: admission class forwarded as X-Query-Class)"""
         path = f"/index/{index}/query"
         params = []
         if shards is not None:
@@ -209,7 +241,9 @@ class Client:
         if params:
             path += "?" + "&".join(params)
         return self._request(
-            "POST", path, pql.encode(), content_type="text/plain")
+            "POST", path, pql.encode(), content_type="text/plain",
+            deadline=deadline,
+            headers=self._query_headers(deadline, query_class))
 
     # -- imports -------------------------------------------------------------
 
@@ -335,6 +369,12 @@ class Client:
         """The peer's SLO burn-rate state (objectives, windows,
         alerting flags)."""
         return self._request("GET", "/debug/slo")
+
+    def debug_admission(self):
+        """The peer's admission-controller snapshot (ladder state,
+        token buckets, queue occupancy); {"enabled": False} when the
+        node runs with --admission off."""
+        return self._request("GET", "/debug/admission")
 
     def debug_flightrecorder(self, limit=None):
         """The peer's flight-recorder tail."""

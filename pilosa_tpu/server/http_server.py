@@ -8,13 +8,15 @@ reference's wire shapes; roaring imports are raw binary bodies.
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..core.index import IndexOptions
 from ..core import timeq
-from .api import ApiError, NotFoundError, ServiceUnavailableError, \
-    field_options_from_json, field_options_to_json, result_to_json
+from .api import ApiError, GatewayTimeoutError, NotFoundError, \
+    ServiceUnavailableError, field_options_from_json, \
+    field_options_to_json, result_to_json
 
 
 class Route:
@@ -169,6 +171,7 @@ class PilosaHTTPServer:
                   args=("top",)),
             Route("GET", r"/debug/optimizer", self._get_debug_optimizer),
             Route("GET", r"/debug/slo", self._get_debug_slo),
+            Route("GET", r"/debug/admission", self._get_debug_admission),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
             Route("GET", r"/debug/ingest", self._get_debug_ingest),
             Route("GET", r"/debug/faultpoints", self._get_faultpoints),
@@ -224,9 +227,39 @@ class PilosaHTTPServer:
         self.api.delete_field(req.params["index"], req.params["field"])
         return {"success": True}
 
+    def _admission_headers(self, req):
+        """(absolute_deadline, query_class) parsed from the request's
+        `X-Request-Deadline` / `X-Query-Class` headers — THE deadline
+        entry point (fan-out legs re-enter here too, so a coordinator's
+        forwarded budget is re-anchored against this node's clock).
+        Malformed values are a 400 at the edge; an already-negative
+        budget still parses (api.query answers it with 504)."""
+        hdrs = getattr(req, "headers", None)
+        qclass = None
+        raw = hdrs.get("X-Query-Class") if hdrs is not None else None
+        if raw is not None:
+            qclass = raw.strip().lower()
+            if qclass not in ("interactive", "batch", "internal"):
+                raise ApiError(
+                    "X-Query-Class must be interactive|batch|internal, "
+                    f"got {raw!r}")
+        deadline = None
+        raw = hdrs.get("X-Request-Deadline") if hdrs is not None else None
+        if raw is not None:
+            from . import admission as admission_mod
+
+            try:
+                remaining = admission_mod.parse_deadline(raw)
+            except ValueError as e:
+                raise ApiError(
+                    f"invalid X-Request-Deadline {raw!r}: {e}") from e
+            deadline = time.monotonic() + remaining
+        return deadline, qclass
+
     def _post_query(self, req):
         from ..exec import ExecOptions
 
+        deadline, qclass = self._admission_headers(req)
         if req.content_type.startswith("application/x-protobuf"):
             # protobuf data plane, wire-compatible with the reference's
             # QueryRequest/QueryResponse (encoding/proto/proto.go)
@@ -240,12 +273,19 @@ class PilosaHTTPServer:
             try:
                 results = self.api.query(
                     req.params["index"], q["query"], shards=q["shards"],
-                    options=options)
+                    options=options, deadline=deadline,
+                    query_class=qclass)
                 attr_sets = self.api.column_attr_sets(
                     req.params["index"], results) \
                     if q["column_attrs"] else None
                 body = encoding.encode_query_response(
                     results, column_attr_sets=attr_sets)
+            except (ServiceUnavailableError, GatewayTimeoutError):
+                # shed/unready/deadline must stay HTTP-visible: the
+                # coordinator keys on the status code and the
+                # Retry-After / X-Pilosa-Shed headers, which an embedded
+                # proto error string would destroy
+                raise
             except ApiError as e:
                 body = encoding.encode_query_response([], err=str(e))
             return RawResponse(body, encoding.CONTENT_TYPE_PROTOBUF)
@@ -278,8 +318,13 @@ class PilosaHTTPServer:
                 "excludeRowAttrs", ["false"])[0] == "true",
             profile=want_profile, explain=explain)
         results = self.api.query(
-            req.params["index"], pql, shards=shards, options=options)
+            req.params["index"], pql, shards=shards, options=options,
+            deadline=deadline, query_class=qclass)
         out = {"results": [result_to_json(r) for r in results]}
+        if self.api.serving_stale():
+            # degradation ladder at STALE_OK+: reads may lag the ingest
+            # staleness bound — marked so clients can tell
+            out["stale"] = True
         if explain is not None:
             from ..exec import plan as plan_mod
 
@@ -764,6 +809,9 @@ class PilosaHTTPServer:
                             "decisions",
         "/debug/slo": "SLO objectives and multi-window error-budget "
                       "burn rates",
+        "/debug/admission": "admission controller: degradation-ladder "
+                            "state + transitions, per-class token "
+                            "buckets, queue occupancy, rejections",
         "/debug/oplog": "write-ahead oplog: LSNs, checkpoint, fsync "
                         "policy, segment state",
         "/debug/ingest": "streaming ingest engine: delta buffer depth, "
@@ -820,6 +868,12 @@ class PilosaHTTPServer:
         from ..utils import workload as workload_mod
 
         return workload_mod.slo().snapshot()
+
+    def _get_debug_admission(self, req):
+        """Admission controller snapshot: ladder state, per-class token
+        buckets + queue occupancy, calibration, transition history
+        ({"enabled": false} when --admission off)."""
+        return self.api.admission_stats()
 
     def _get_debug_oplog(self, req):
         """Durable-oplog summary: segments, checkpoint, replay lag."""
@@ -1076,7 +1130,8 @@ class PilosaHTTPServer:
                                  + ", ".join(sorted(unknown))}
                     break
             req = Request(m.groupdict(), query, body,
-                          handler.headers.get("Content-Type", ""))
+                          handler.headers.get("Content-Type", ""),
+                          headers=handler.headers)
             # Continue a cross-node trace from incoming headers (reference:
             # http/handler.go:321 extractTracing middleware).
             with tracing.span_from_headers(
@@ -1193,13 +1248,16 @@ class _SamplingProfiler:
 
 
 class Request:
-    __slots__ = ("params", "query", "body", "content_type")
+    __slots__ = ("params", "query", "body", "content_type", "headers")
 
-    def __init__(self, params, query, body, content_type=""):
+    def __init__(self, params, query, body, content_type="", headers=None):
         self.params = params
         self.query = query
         self.body = body
         self.content_type = content_type
+        # the raw http.client message (dict-like, case-insensitive) —
+        # None in tests that build Requests by hand
+        self.headers = headers
 
     def json(self):
         if not self.body:

@@ -10,10 +10,11 @@ from pilosa_tpu.server import API, Client, PilosaHTTPServer
 class ServerHarness:
     """One in-process node: holder + API + HTTP on an ephemeral port."""
 
-    def __init__(self, data_dir=None):
+    def __init__(self, data_dir=None, **api_kwargs):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="pilosa_tpu_test_")
         self.holder = Holder(self.data_dir, use_snapshot_queue=False).open()
-        self.api = API(self.holder)
+        self._api_kwargs = api_kwargs
+        self.api = API(self.holder, **api_kwargs)
         self.server = PilosaHTTPServer(self.api, host="127.0.0.1", port=0)
         self.server.start()
         self.client = Client(self.server.address)
@@ -26,7 +27,7 @@ class ServerHarness:
         """Restart from disk (reference: test/Command.Reopen)."""
         self.server.stop()
         self.holder.reopen()
-        self.api = API(self.holder)
+        self.api = API(self.holder, **self._api_kwargs)
         self.server = PilosaHTTPServer(self.api, host="127.0.0.1", port=0)
         self.server.start()
         self.client = Client(self.server.address)
@@ -42,7 +43,7 @@ class ClusterHarness:
     test.MustRunCluster test/pilosa.go:390 — real servers, real HTTP,
     ephemeral ports; ModHasher optionally for deterministic placement)."""
 
-    def __init__(self, n, replica_n=1, hasher=None):
+    def __init__(self, n, replica_n=1, hasher=None, api_kwargs=None):
         from pilosa_tpu.cluster import Cluster, Node
 
         # phase 1: boot servers (cluster-less) to learn ephemeral ports
@@ -58,7 +59,8 @@ class ClusterHarness:
                 nodes=[Node(n_.id, n_.uri) for n_ in node_list],
                 local_id=local_id, replica_n=replica_n, hasher=hasher,
                 path=h.data_dir)
-            h.api = API(h.holder, cluster=cluster, client_factory=Client)
+            h.api = API(h.holder, cluster=cluster, client_factory=Client,
+                        **(api_kwargs or {}))
             h.server.api = h.api
             h.cluster = h.api.cluster
 

@@ -460,8 +460,10 @@ class TestResizeQueueDurability:
             with pytest.raises(ServiceUnavailableError) as ei:
                 api.import_bits("i", "f", [1], [3])
             assert ei.value.status == 503
-            assert ei.value.headers["Retry-After"] == str(
-                api.RESIZE_QUEUE_RETRY_AFTER)
+            # jittered x1.0-1.25 by the shared shed_reject helper
+            base = api.RESIZE_QUEUE_RETRY_AFTER
+            assert base <= float(ei.value.headers["Retry-After"]) <= base * 1.25 + 1
+            assert ei.value.headers["X-Pilosa-Shed"] == "resize_queue"
             # still an ApiError matching the pre-existing contract
             assert isinstance(ei.value, ApiError)
             assert "queue full" in str(ei.value)
