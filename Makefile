@@ -16,11 +16,12 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
 	test-workload test-batching test-containers test-adaptive \
-	test-ingest test-admission lint bench-cpu
+	test-ingest test-admission test-fusion lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
-	test-containers test-adaptive test-ingest test-admission
+	test-containers test-adaptive test-ingest test-admission \
+	test-fusion
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -104,6 +105,12 @@ test-adaptive:
 # overload-vs-unready handling on fan-out, and /debug/admission.
 test-admission:
 	$(PY) -m pytest tests/test_admission.py $(PYTEST_FLAGS)
+
+# Whole-plan fusion surface: the fused==interpreted differential corpus,
+# single-dispatch warm queries, cold-fingerprint compile admission,
+# program-cache LRU eviction, shadow A/B, and /debug/fusion.
+test-fusion:
+	$(PY) -m pytest tests/test_fusion.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

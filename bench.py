@@ -94,6 +94,18 @@ def _adaptive_tag():
         return None, None
 
 
+def _fusion_tag():
+    """(mode, decision counters) of the whole-plan fusion engine for
+    attempt tagging — a run where queries traced into fused programs is
+    only comparable to another run under the same --fusion policy."""
+    try:
+        from pilosa_tpu.exec import fusion
+
+        return fusion.mode(), fusion.decision_counts()
+    except Exception:
+        return None, None
+
+
 def _ingest_mode():
     """Streaming ingest engine mode ("off" or "interval=<n>s") tagged
     into every emitted record — write-path numbers are only comparable
@@ -286,6 +298,7 @@ def main():
         if served.get("n_shards") == n_shards else 0.0
     best_qps = max(qps, served_qps)
     adaptive_mode, adaptive_decisions = _adaptive_tag()
+    fusion_mode, fusion_decisions = _fusion_tag()
     print(json.dumps({
         "metric": f"pql_intersect_count_qps_{n_columns // 1_000_000}M_cols",
         "value": round(best_qps, 2),
@@ -331,6 +344,11 @@ def main():
             # steering the run it is comparing against
             "adaptive_mode": adaptive_mode,
             "adaptive_decisions": adaptive_decisions,
+            # whole-plan fusion mode + fuse/interpret counters: a fused
+            # run pays one dispatch per query, an interpreted one pays
+            # one per call — latency comparisons must be like-for-like
+            "fusion_mode": fusion_mode,
+            "fusion_decisions": fusion_decisions,
             # streaming ingest engine mode: write-path comparisons must
             # be like-for-like on the delta-buffer policy too
             "ingest_mode": _ingest_mode(),

@@ -346,6 +346,23 @@ def cmd_server(args):
 
         _adaptive.configure(mode=str(amode))
 
+    # Whole-plan fusion (exec/fusion.py module state): "on" traces
+    # eligible queries into ONE jitted program cached by workload
+    # fingerprint; "shadow" counts what would fuse but compiles
+    # nothing; the default "off" keeps the legacy per-call loop
+    # byte-for-byte. Validated here so a typo fails startup, not
+    # first query.
+    fmode = config.get("fusion")
+    fcache = config.get("fusion-cache-size")
+    fhits = config.get("fusion-min-hits")
+    if fmode is not None or fcache is not None or fhits is not None:
+        from .exec import fusion as _fusion
+
+        _fusion.configure(
+            mode=str(fmode) if fmode is not None else None,
+            cache_size=int(fcache) if fcache is not None else None,
+            min_hits=int(fhits) if fhits is not None else None)
+
     # SLO objectives: error-budget burn rate over the existing timing
     # histograms (utils/workload.py module state). Accepts a repeated
     # --slo flag (list) or a comma-separated string from the config file.
@@ -823,7 +840,9 @@ def _apply_server_flags(config, args):
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
                  "coalesce_window", "coalesce_max_queue",
-                 "container_repr", "adaptive", "ingest_merge_interval",
+                 "container_repr", "adaptive",
+                 "fusion", "fusion_cache_size", "fusion_min_hits",
+                 "ingest_merge_interval",
                  "admission", "admission_capacity",
                  "admission_queue_depth", "admission_queue_timeout"):
         val = getattr(args, flag, None)
@@ -1049,6 +1068,24 @@ def main(argv=None):
                         "cost model + fragment heat; shadow computes and "
                         "logs decisions without acting; off (default) "
                         "keeps the legacy static paths byte-for-byte")
+    p.add_argument("--fusion", default=None,
+                   choices=["off", "on", "shadow"],
+                   help="whole-plan fusion: on traces an eligible "
+                        "query's every top-level Count into ONE jitted "
+                        "device program cached by workload fingerprint "
+                        "(a cold fingerprint never pays a compile); "
+                        "shadow counts what would fuse without "
+                        "compiling; off (default) keeps the legacy "
+                        "per-call loop byte-for-byte")
+    p.add_argument("--fusion-cache-size", type=int, default=None,
+                   help="bounded LRU of fused programs per process "
+                        "(default 64); eviction drops the compiled "
+                        "program, so re-entry re-compiles")
+    p.add_argument("--fusion-min-hits", type=int, default=None,
+                   help="completed queries a workload fingerprint needs "
+                        "before its first fused trace+compile "
+                        "(default 2); raise it when /debug/fusion shows "
+                        "compiles outnumbering cache hits")
     p.add_argument("--ingest-merge-interval", default=None,
                    help="streaming ingest merge interval (e.g. 250ms): "
                         "import deltas buffer host-side (still "
@@ -1189,6 +1226,10 @@ def main(argv=None):
                    choices=["auto", "dense", "sparse", "rle"])
     p.add_argument("--adaptive", default=None,
                    choices=["off", "on", "shadow"])
+    p.add_argument("--fusion", default=None,
+                   choices=["off", "on", "shadow"])
+    p.add_argument("--fusion-cache-size", type=int, default=None)
+    p.add_argument("--fusion-min-hits", type=int, default=None)
     p.add_argument("--fsync", default=None,
                    choices=["always", "interval", "never"])
     p.add_argument("--no-oplog", action="store_true", default=False)

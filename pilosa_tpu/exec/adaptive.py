@@ -166,6 +166,7 @@ def reset():
         _forced_tile = None
         _fallback.clear()
         _pairwise.clear()
+        _fuse_compile.clear()
         _strategy_counts.clear()
         _tile_counts.clear()
         _recent.clear()
@@ -367,6 +368,77 @@ def decide_strategy(op, kernels, n_shards, missing_bytes=0, stacked=None):
         "est_stacked_ms": round(est_stacked * 1000, 3),
         "est_fallback_ms": round(est_fallback * 1000, 3),
         "source": worst})
+    return dec
+
+
+class FuseDecision:
+    """One priced fuse-vs-interpret choice (exec/fusion.py consults it
+    AFTER the frequency gate has already admitted the fingerprint)."""
+
+    __slots__ = ("fuse", "act", "est_fused", "est_interpret", "source",
+                 "chosen_by")
+
+    def __init__(self, fuse, act, est_fused, est_interpret, source):
+        self.fuse = fuse
+        self.act = act
+        self.est_fused = est_fused
+        self.est_interpret = est_interpret
+        self.source = source
+        self.chosen_by = (
+            f"cost-model (est fused={est_fused * 1000:.2f}ms vs "
+            f"interpret={est_interpret * 1000:.2f}ms)")
+
+
+#: compile-cost prior for one fused trace before any observation:
+#: trace+compile of a small count DAG is tens-of-ms-scale on every
+#: backend we run; the fused_compile_ms EWMA replaces it after the
+#: first real compile
+DEFAULT_FUSE_COMPILE_SECONDS = 50e-3
+
+_fuse_compile = {}  # single-key EWMA table: "compile" -> [seconds, n]
+
+
+def observe_fuse_compile(wall_seconds):
+    """Feed one observed fused trace+compile wall (any enabled mode)."""
+    if _mode == "off" or wall_seconds <= 0:
+        return
+    with _lock:
+        _ewma_update(_fuse_compile, "compile", wall_seconds)
+
+
+def decide_fuse(n_calls, fp_hits, cached, stacked=None):
+    """Price fused (one dispatch + compile amortized over the
+    fingerprint's observed frequency) vs interpreted (one count
+    dispatch per top-level call). `cached`: a live program means the
+    compile is sunk and fused strictly dominates. Returns None when
+    the engine is off — the fusion module then relies on its frequency
+    gate alone."""
+    if _mode == "off":
+        return None
+    per_dispatch, source = dispatch_seconds("count", stacked=stacked)
+    est_interpret = n_calls * per_dispatch
+    if cached:
+        est_fused = per_dispatch
+    else:
+        with _lock:
+            e = _fuse_compile.get("compile")
+        compile_s = e[0] if e is not None and e[1] \
+            else DEFAULT_FUSE_COMPILE_SECONDS
+        # amortize the compile over the reuse the frequency ranking
+        # predicts: a shape seen N times is priced as if it returns N
+        # more times before churning out of the workload
+        est_fused = per_dispatch + compile_s / max(1, fp_hits)
+    fuse = est_fused <= est_interpret
+    dec = FuseDecision(fuse, acting(), est_fused, est_interpret, source)
+    with _lock:
+        k = ("Fuse", "fused" if fuse else "interpret")
+        _strategy_counts[k] = _strategy_counts.get(k, 0) + 1
+    _record_decision("fuse", {
+        "calls": n_calls, "fp_hits": fp_hits, "cached": cached,
+        "fuse": fuse, "acted": dec.act,
+        "est_fused_ms": round(est_fused * 1000, 3),
+        "est_interpret_ms": round(est_interpret * 1000, 3),
+        "source": source})
     return dec
 
 

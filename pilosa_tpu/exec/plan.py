@@ -360,7 +360,36 @@ class Planner:
     # -- entry ---------------------------------------------------------------
 
     def plan_query(self, idx, calls, shards, opt):
-        return [self.plan_call(idx, call, shards, opt) for call in calls]
+        nodes = [self.plan_call(idx, call, shards, opt) for call in calls]
+        self._annotate_fusion(idx, calls, nodes)
+        return nodes
+
+    def _annotate_fusion(self, idx, calls, nodes):
+        """Whole-plan fusion annotation (host metadata only — the plan
+        path's zero-dispatch contract holds): when fusion is enabled
+        and every top-level call is a stacked-covered Count, serving
+        would trace the whole query into ONE jitted program, so each
+        node gains `fused: true` plus the program-cache key status for
+        the query's workload fingerprint (cached = a warm program
+        exists; uncompiled = the first admitted execution would pay
+        the trace+compile)."""
+        from ..pql.ast import Query
+        from ..utils import workload
+        from . import fusion
+
+        if not fusion.enabled() or not calls:
+            return
+        if any(c.name != "Count" or len(c.children) != 1
+               for c in calls):
+            return
+        if any(n.strategy != "stacked" for n in nodes):
+            return
+        fp, _ = workload.fingerprint(idx.name, Query(list(calls)))
+        status = fusion.cache_status(fp)
+        for n in nodes:
+            n.annotations["fused"] = True
+            n.annotations["fusion_fingerprint"] = fp
+            n.annotations["fusion_program"] = status
 
     def plan_call(self, idx, call, shards, opt):
         handler = {
@@ -1017,6 +1046,13 @@ def flag_misestimates(node, factor=None):
     underestimate hides a regression). One `explain_misestimates_total
     {op}` tick per flagged node, not per metric."""
     if node.actual is None or not node.estimate:
+        return node
+    if node.annotations.get("fused"):
+        # the estimate priced the interpreted per-call path, but the
+        # node executed inside ONE fused program — any deviation is the
+        # strategy change itself, not cost-model drift, and flagging it
+        # would spam the triage ring on every fused analyze
+        node.misestimates = []
         return node
     factor = _misestimate_factor if factor is None else factor
     checks = (

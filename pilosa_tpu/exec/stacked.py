@@ -520,6 +520,9 @@ class StackedEvaluator:
         # launch_query_batch dispatches vs the queries that rode them.
         self.batch_dispatches = 0
         self.batched_queries = 0
+        # Whole-plan fusion observability (GET /debug/fusion): queries
+        # whose every top-level Count rode ONE fused device program.
+        self.fused_dispatches = 0
 
     def _stack_sharding(self):
         """NamedSharding over all local devices (None on a single device),
@@ -1438,6 +1441,70 @@ class StackedEvaluator:
 
         return self._get_fn(("countB", sig, csig, batch), build)
 
+    def fused_count_fn(self, plans):
+        """A whole query's Count trees fused into ONE program (exec/
+        fusion.py). `plans` is a tuple of (sig, csig) per top-level
+        call — unlike _count_batch_fn the trees need NOT share a
+        signature; each call's components are sliced off the flat
+        argument list by its own arity and traced through its own
+        count_program, so the fused program inlines dense, sparse, RLE
+        and overlay-carrying containers side by side. Outputs are
+        [n_calls] (hi, lo) vectors — the same 16-bit overflow-split
+        contract as every count program."""
+        import jax
+        import jax.numpy as jnp
+
+        plans = tuple((sig, _containers.norm_csig(csig))
+                      for sig, csig in plans)
+        key = ("fused", plans)
+
+        def build():
+            @jax.jit
+            def fn(*all_flat):
+                his, los = [], []
+                i = 0
+                for sig, csig in plans:
+                    af = _containers.flat_arity(csig)
+                    hi, lo = _containers.count_program(
+                        sig, csig, all_flat[i:i + af], self._tree_eval)
+                    i += af
+                    his.append(hi)
+                    los.append(lo)
+                return jnp.stack(his), jnp.stack(los)
+
+            return fn
+
+        return self._get_fn(key, build), key
+
+    def fused_count(self, plans, stacks_per_call):
+        """Execute a whole query's Count calls as ONE locked dispatch +
+        one group-committed fetch. Returns (counts, fn_key, compiled):
+        per-call host ints in call order, the program's fn-cache key
+        (exec/fusion.py pins it so its LRU eviction can drop the
+        compiled fn too), and whether THIS invocation traced+compiled
+        (first call on the key — same detection _locked_dispatch uses
+        to relabel dispatch_ack as compile)."""
+        fn, key = self.fused_count_fn(plans)
+        compiled = key not in self._fn_specs
+        args, nbytes_in = [], 0
+        for stacks in stacks_per_call:
+            args.extend(_containers.flatten(stacks))
+            nbytes_in += sum(c.nbytes for c in stacks)
+        self.dispatches += 1
+        with self._lock:
+            self.fused_dispatches += 1
+        with self._locked_dispatch("fused", nbytes_in=nbytes_in,
+                                   fn=fn) as ph:
+            his, los = fn(*args)
+            ph.mark("dispatch_ack")
+            _launch_barrier((his, los))
+            ph.mark("sync")
+        # amortized result fetch (group commit, like _batched_count)
+        vals = self._fetch_commit.submit((his, los), _device_get_batch)
+        his_h, los_h = np.atleast_1d(vals[0]), np.atleast_1d(vals[1])
+        counts = [combine_hi_lo(h, l) for h, l in zip(his_h, los_h)]
+        return counts, key, compiled
+
     #: count-batcher buckets: batch sizes are rounded up to a power of two
     #: (padding repeats the first query) so at most log2(MAX) programs
     #: compile per signature; 32 keeps device time per dispatch (~11 ms at
@@ -2099,6 +2166,7 @@ class StackedEvaluator:
                 "count_batched_queries": self._count_commit.batched,
                 "batch_dispatches": self.batch_dispatches,
                 "batched_queries": self.batched_queries,
+                "fused_dispatches": self.fused_dispatches,
                 "stack_bytes": self._stack_bytes,
                 "stack_entries": len(self._stacks),
                 "rows_stack_bytes": self._rows_stack_bytes,

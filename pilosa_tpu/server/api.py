@@ -1241,6 +1241,10 @@ class API:
             from ..utils import workload as workload_mod
 
             q = pql if isinstance(pql, str) else str(pql)
+            # coalesced members pass fp explicitly (executed on the
+            # coalescer thread) — this thread's fused stamp is theirs
+            # only on the direct path
+            coalesced = fp is not None
             # the executor just finished this query on THIS thread, so
             # its fingerprint is in take-last position — slow lines for
             # the same shape grep together across the fleet
@@ -1250,18 +1254,27 @@ class API:
                 from ..exec.stacked import last_batch_size
                 batch = last_batch_size()
             batch = max(1, int(batch))
+            # whole-plan fusion stamp (same take-last handoff as the
+            # fingerprint): how many top-level calls rode ONE fused
+            # device program, 0 = the query ran interpreted. Coalesced
+            # members (explicit fp) executed on the coalescer thread,
+            # so THIS thread's stamp is stale — they report 0 (the
+            # coalescer path never fuses whole plans).
+            from ..exec import fusion as fusion_mod
+            fused = 0 if coalesced else fusion_mod.last_fused()
             flightrec.record("query.slow", index=index_name,
                              seconds=round(elapsed, 3), pql=q[:200],
-                             fingerprint=fp, batch=batch)
+                             fingerprint=fp, batch=batch, fused=fused)
             if prof is not None:
-                # trace=, fingerprint=, batch=, and plan= ride ahead of
-                # profile=, which stays the LAST field: consumers parse
-                # the profile JSON as everything after "profile=" (tests
-                # pin this format; they also pin plan= through " plan="/
-                # " profile=" splits, so batch= sits BEFORE plan=).
-                # analyze queries stamp a full summary (with ! marking
-                # misestimated ops); otherwise derive one from whatever
-                # strategy notes the decision points emitted
+                # trace=, fingerprint=, batch=, fused=, and plan= ride
+                # ahead of profile=, which stays the LAST field:
+                # consumers parse the profile JSON as everything after
+                # "profile=" (tests pin this format; they also pin
+                # plan= through " plan="/" profile=" splits, so batch=
+                # and fused= sit BEFORE plan=). analyze queries stamp a
+                # full summary (with ! marking misestimated ops);
+                # otherwise derive one from whatever strategy notes the
+                # decision points emitted
                 plan = prof.tag("plan_summary")
                 if not plan:
                     strategies = prof.tag("strategies")
@@ -1270,13 +1283,14 @@ class API:
                         for s in strategies) if strategies else "-"
                 self.logger.printf(
                     "%.03fs SLOW QUERY index=%s %s trace=%s fingerprint=%s "
-                    "batch=%d plan=%s profile=%s", elapsed, index_name,
-                    q[:500], prof.root.trace_id, fp, batch, plan,
-                    _json.dumps(prof.to_dict()))
+                    "batch=%d fused=%d plan=%s profile=%s", elapsed,
+                    index_name, q[:500], prof.root.trace_id, fp, batch,
+                    fused, plan, _json.dumps(prof.to_dict()))
             else:
                 self.logger.printf(
-                    "%.03fs SLOW QUERY index=%s %s fingerprint=%s batch=%d",
-                    elapsed, index_name, q[:500], fp, batch)
+                    "%.03fs SLOW QUERY index=%s %s fingerprint=%s "
+                    "batch=%d fused=%d",
+                    elapsed, index_name, q[:500], fp, batch, fused)
 
     # -- schema DDL ---------------------------------------------------------
 

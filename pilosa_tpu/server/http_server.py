@@ -170,6 +170,7 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/heat", self._get_debug_heat,
                   args=("top",)),
             Route("GET", r"/debug/optimizer", self._get_debug_optimizer),
+            Route("GET", r"/debug/fusion", self._get_debug_fusion),
             Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/admission", self._get_debug_admission),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
@@ -807,6 +808,10 @@ class PilosaHTTPServer:
         "/debug/optimizer": "adaptive execution engine: calibration "
                             "sources, decision counters, recent "
                             "decisions",
+        "/debug/fusion": "whole-plan fusion: mode, program cache "
+                         "(fingerprint / compile-ms / hits / last-hit "
+                         "age), evictions, fuse-vs-interpret decision "
+                         "counters",
         "/debug/slo": "SLO objectives and multi-window error-budget "
                       "burn rates",
         "/debug/admission": "admission controller: degradation-ladder "
@@ -861,6 +866,14 @@ class PilosaHTTPServer:
         local = self._local_executor()
         return adaptive.snapshot(
             stacked=getattr(local, "_stacked", None))
+
+    def _get_debug_fusion(self, req):
+        """Whole-plan fusion state: mode + knobs, the bounded program
+        ledger with per-entry compile cost and hit recency, and the
+        fuse-vs-interpret decision counters (exec/fusion.py)."""
+        from ..exec import fusion
+
+        return fusion.snapshot()
 
     def _get_debug_slo(self, req):
         """SLO objectives with fast/slow-window error-budget burn rates

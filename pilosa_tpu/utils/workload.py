@@ -180,6 +180,16 @@ class WorkloadTable:
             "idle_seconds": round(time.time() - e["last_seen"], 1),
         }
 
+    def hits(self, fp):
+        """Completed-query count for one fingerprint (0 when unseen or
+        evicted). NOT an access (no LRU touch): exec/fusion.py probes
+        this on every enabled query for its compile-admission gate, and
+        a probe that refreshed recency would let the gate itself keep
+        cold shapes resident."""
+        with self._lock:
+            e = self._entries.get(fp)
+            return e["count"] if e is not None else 0
+
     def snapshot(self, top=20):
         """GET /debug/workload: the three rankings the optimizer loop
         reads — what runs most, what costs most, what the cost model
@@ -745,6 +755,13 @@ def last_fingerprint():
     """The fingerprint of the last query finished on THIS thread (the
     slow-query log reads it after the executor returns)."""
     return getattr(_local, "last_fingerprint", None)
+
+
+def fingerprint_hits(fp):
+    """How many queries of this shape have COMPLETED — the frequency
+    signal exec/fusion.py's compile-admission gate reads (a fingerprint
+    below --fusion-min-hits never pays a trace+compile)."""
+    return _table.hits(fp)
 
 
 def maybe_sample_slo():

@@ -395,9 +395,9 @@ def test_http_debug_batching(srv):
 
 
 def test_slow_query_line_batch_attribution(srv):
-    """SLOW QUERY lines carry batch= between fingerprint= and plan=;
-    profile= stays LAST so existing parsers keep working. The
-    coalesced path's line carries the member's own fingerprint even
+    """SLOW QUERY lines carry batch= (and fused=) between fingerprint=
+    and plan=; profile= stays LAST so existing parsers keep working.
+    The coalesced path's line carries the member's own fingerprint even
     though end_query ran on the coalescer thread."""
     import re
 
@@ -408,7 +408,8 @@ def test_slow_query_line_batch_attribution(srv):
     srv.client.query("i", "Count(Row(f=1))")
     line = [ln for ln in log.lines if "SLOW QUERY" in ln][-1]
     assert " batch=" in line
-    assert re.search(r"fingerprint=([0-9a-f]{16}) batch=\d+ plan=", line)
+    assert re.search(
+        r"fingerprint=([0-9a-f]{16}) batch=\d+ fused=\d+ plan=", line)
     # plan= field parsing (pinned by test_explain) is unchanged
     assert line.split(" plan=", 1)[1].split(" profile=", 1)[0] \
         == "Count=stacked"
@@ -420,7 +421,7 @@ def test_slow_query_line_batch_attribution(srv):
                long_query_time=0.0, logger=log)
     capi.query("i", "Count(Row(f=1))")
     line2 = [ln for ln in log.lines if "SLOW QUERY" in ln][-1]
-    m = re.search(r"fingerprint=([0-9a-f]{16}) batch=(\d+)$",
+    m = re.search(r"fingerprint=([0-9a-f]{16}) batch=(\d+) fused=\d+$",
                   line2.strip())
     assert m, line2
     assert int(m.group(2)) >= 1
