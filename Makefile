@@ -16,12 +16,12 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
 	test-workload test-batching test-containers test-adaptive \
-	test-ingest test-admission test-fusion lint bench-cpu
+	test-ingest test-admission test-fusion test-incident lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
 	test-containers test-adaptive test-ingest test-admission \
-	test-fusion
+	test-fusion test-incident
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -111,6 +111,12 @@ test-admission:
 # program-cache LRU eviction, shadow A/B, and /debug/fusion.
 test-fusion:
 	$(PY) -m pytest tests/test_fusion.py $(PYTEST_FLAGS)
+
+# Incident autopsy surface: cross-node trace assembly (skew-corrected
+# merged span trees), anomaly-triggered postmortem bundles, /metrics
+# exemplars, and the /debug/traces//incidents/threads endpoints.
+test-incident:
+	$(PY) -m pytest tests/test_incident.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

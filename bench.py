@@ -430,6 +430,31 @@ def _start_prober() -> None:
         pass
 
 
+def _configure_incidents() -> None:
+    """Arm incident autopsy for the attempt: anomalies during the
+    measurement (device link DOWN, watchdog stall, deadline storm)
+    write postmortem bundles that SURVIVE the parent's kill — the
+    parent attaches the newest bundle's path to the failed-attempt
+    record so "device tunnel hung" comes with a full forensic capture
+    instead of one kill line. Dir from PILOSA_TPU_BENCH_INCIDENT_DIR
+    ("0"/"off" disables), defaulting under the system tmpdir. Never
+    fatal."""
+    try:
+        inc_dir = os.environ.get("PILOSA_TPU_BENCH_INCIDENT_DIR", "")
+        if inc_dir.lower() in ("0", "off", "no"):
+            return
+        if not inc_dir:
+            import tempfile
+
+            inc_dir = os.path.join(tempfile.gettempdir(),
+                                   "pilosa_tpu_bench_incidents")
+        from pilosa_tpu.utils import incident
+
+        incident.configure(inc_dir, min_interval=0.0)
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
 def _device_link_tag():
     """Compact {state, last_canary_rtt_ms} from the in-process prober,
     or None when it never started. Attached to the child's own error
@@ -471,6 +496,7 @@ def _child() -> None:
     int(jax.jit(lambda v: v + 1)(jnp.int32(1)))  # trivial jit round trip
     print(PROBE_MARKER, file=sys.stderr, flush=True)
     _start_prober()
+    _configure_incidents()
     main()
 
 
@@ -488,6 +514,7 @@ def _child_fake(mode: str) -> None:
         # itself then hangs like a wedged measurement.
         from pilosa_tpu.utils import devhealth
 
+        _configure_incidents()
         devhealth.configure(canary=lambda: time.sleep(60),
                             interval=0.1, deadline=0.2)
         print(PROBE_MARKER, file=sys.stderr, flush=True)
@@ -649,6 +676,24 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         except Exception:  # noqa: BLE001 — the child may be truly wedged
             return None
 
+    def fetch_incidents():
+        """Newest completed postmortem bundle the child wrote (same
+        debug port serves /debug/incidents). Bundles are directories on
+        disk, so the returned path stays valid after the kill."""
+        if debug_port[0] is None:
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{debug_port[0]}/debug/incidents",
+                    timeout=2) as resp:
+                snap = json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — the child may be truly wedged
+            return None
+        incidents = snap.get("incidents") or []
+        return incidents[0] if incidents else None
+
     def pump_out():
         for line in proc.stdout:
             out_lines.append(line)
@@ -670,6 +715,7 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
         tail = fetch_flightrec()
         dev = fetch_device()
         disp = fetch_dispatch()
+        inc = fetch_incidents()
         proc.kill()
         proc.wait()
         te.join(timeout=5)
@@ -697,6 +743,10 @@ def _run_attempt(remaining: float, probe_deadline: float, extra_env=None):
                 "last_canary_rtt_ms": round(rtt * 1000, 3)
                 if rtt is not None else None,
             }
+        if inc is not None:
+            rec["incident_bundle"] = {"id": inc.get("id"),
+                                      "kind": inc.get("kind"),
+                                      "path": inc.get("path")}
         return rec
 
     t0 = time.perf_counter()

@@ -2354,6 +2354,101 @@ def bench_fusion():
         "disabled_overhead_pct": round(overhead_pct, 4)})
 
 
+# --------------------------------------------------------------- config 19
+
+def bench_incident_overhead():
+    """Incident autopsy acceptance leg.
+
+    Three claims, one JSON line:
+    1. The disabled-path hooks the autopsy adds to serving — the
+       maybe_trigger global check on the anomaly edges, the
+       note_deadline_expiry call on rejection paths, and the
+       exemplars-off branch + trace_id kwarg in stats.timing — cost
+       <2% of an api_nop query even charged at one full set per query
+       (in reality they fire only on rejections and transitions).
+    2. Trigger-to-bundle-on-disk latency is bounded: a sync trigger
+       returns with meta.json present; an async trigger's bundle is
+       listed within seconds. Both latencies are published.
+    3. The refractory window suppresses a same-kind re-trigger.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import incident
+    from pilosa_tpu.utils.stats import StatsClient
+
+    platform, holder, api, ex = _env()
+    api.create_index("inc")
+    api.create_field("inc", "a")
+    idx = holder.index("inc")
+    n_shards = 2
+    rng = np.random.default_rng(29)
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=50_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    api.executor = ex
+    pql = "Count(Row(a=1))"
+    api.query("inc", pql)  # warm stacks + compile
+
+    n_q = 50 if platform == "cpu" else 200
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("inc", pql)
+    query_ms = (time.perf_counter() - t0) / n_q * 1000
+
+    # disabled-path microbench: every hook the feature adds, at once
+    incident.stop()
+    sc = StatsClient()
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        incident.maybe_trigger("bench_probe")
+        incident.note_deadline_expiry()
+        sc.timing("bench_probe_seconds", 0.001, trace_id=None)
+    per_set_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = per_set_ns / 1e6 / query_ms * 100
+    assert overhead_pct < 2.0, (
+        f"disabled incident/exemplar hooks cost {overhead_pct:.3f}% of "
+        "an api_nop query — no longer an always-on-safe default")
+
+    # trigger -> bundle-on-disk latency (sync and async paths)
+    d = tempfile.mkdtemp(prefix="pilosa_incident_bench_")
+    try:
+        mgr = incident.configure(d, min_interval=300.0)
+        t0 = time.perf_counter()
+        path = mgr.trigger("bench_sync", sync=True)
+        sync_ms = (time.perf_counter() - t0) * 1000
+        assert path and os.path.isfile(os.path.join(path, "meta.json")), \
+            "sync trigger returned without a complete bundle on disk"
+        assert mgr.trigger("bench_sync", sync=True) is None, \
+            "refractory window did not suppress a same-kind re-trigger"
+        t0 = time.perf_counter()
+        assert mgr.trigger("bench_async") is not None
+        while not any(m["kind"] == "bench_async" for m in mgr.list()):
+            time.sleep(0.002)
+            assert time.perf_counter() - t0 < 30, \
+                "async bundle never became listable"
+        async_ms = (time.perf_counter() - t0) * 1000
+        files = mgr.list()[0]["files"]
+    finally:
+        incident.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+    _close(holder)
+    _emit("incident_overhead_pct", overhead_pct, 1.0, {
+        "platform": platform, "n_shards": n_shards,
+        "api_nop_ms": round(query_ms, 3),
+        "disabled_hook_set_ns": round(per_set_ns, 1),
+        "overhead_pct": round(overhead_pct, 4),
+        "sync_trigger_to_bundle_ms": round(sync_ms, 2),
+        "async_trigger_to_listed_ms": round(async_ms, 2),
+        "bundle_files": files,
+        "suppressed_by_refractory": 1})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -2373,6 +2468,7 @@ CONFIGS = {
     "ingest_qps": bench_ingest_qps,
     "overload": bench_overload,
     "fusion": bench_fusion,
+    "incident_overhead": bench_incident_overhead,
 }
 
 

@@ -393,6 +393,37 @@ def cmd_server(args):
         _tracing.set_tracer(_tracing.InMemoryTracer(
             max_spans=int(config.get("trace-max-spans", 10000))))
 
+    # Incident autopsy (utils/incident.py module state): opt-in writer of
+    # anomaly-triggered postmortem bundles (devhealth DOWN, watchdog
+    # stall, SLO burn, deadline storms, SIGTERM). Without --incident-dir
+    # every hook site is one module-global check.
+    inc_dir = config.get("incident-dir")
+    if inc_dir:
+        from .utils import incident as _incident
+
+        inc_max = config.get("incident-max")
+        _incident.configure(
+            str(inc_dir),
+            max_incidents=int(inc_max) if inc_max is not None
+            else _incident.DEFAULT_MAX_INCIDENTS,
+            logger=_FrLogger())
+        # bundle surfaces that live on instances, not modules
+        _incident.register_collector(
+            "oplog",
+            lambda: (dict(api.oplog.summary(), enabled=True)
+                     if getattr(api, "oplog", None) is not None
+                     else {"enabled": False}))
+        _incident.register_collector("admission", api.admission_stats)
+
+    # Metrics exemplars: timing histograms keep one recent trace id per
+    # bucket, exposed in OpenMetrics exemplar syntax on /metrics and in
+    # /debug/slo. Opt-in; the disabled path is one flag check.
+    if config.get("metrics-exemplars"):
+        from .utils import stats as _stats_mod
+
+        _stats_mod.configure_exemplars(
+            True, registry=_stats_mod.registry_of(stats))
+
     # Diagnostics phone-home: opt-in only, requires an explicit endpoint
     # (reference: diagnostics.go + server.go:760; default ON there, OFF
     # here — no default public endpoint).
@@ -508,6 +539,9 @@ def cmd_server(args):
             diagnostics.stop()
         if _devhealth is not None:
             _devhealth.stop()
+        from .utils import incident as _incident_mod
+
+        _incident_mod.stop()
         _flightrec.stop_watchdog()
         runtime_monitor.stop()
         if translate_repl:
@@ -836,6 +870,7 @@ def _apply_server_flags(config, args):
                  "replicas", "spmd_port", "long_query_time",
                  "max_writes_per_request", "tracing", "workers",
                  "flight_recorder_size", "watchdog_deadline",
+                 "incident_dir", "incident_max", "metrics_exemplars",
                  "plan_ring_size", "explain_misestimate_factor",
                  "device_probe_interval", "device_probe_deadline",
                  "slo", "slo_burn_threshold",
@@ -1018,6 +1053,20 @@ def main(argv=None):
                    help="stall watchdog deadline (e.g. 30s, 2m): dump "
                         "stacks + recorder tail when a dispatch or query "
                         "runs past it; disabled when unset")
+    p.add_argument("--incident-dir", default=None,
+                   help="directory for anomaly-triggered postmortem "
+                        "bundles (flightrec dump, thread stacks, /debug "
+                        "snapshots) written on devhealth DOWN, watchdog "
+                        "stall, SLO burn, deadline storms, and SIGTERM; "
+                        "served at /debug/incidents; disabled when unset")
+    p.add_argument("--incident-max", type=int, default=None,
+                   help="retained incident bundles before the oldest is "
+                        "deleted (default 16)")
+    p.add_argument("--metrics-exemplars", action="store_true",
+                   default=None,
+                   help="keep one recent trace id per timing-histogram "
+                        "bucket and expose it in OpenMetrics exemplar "
+                        "syntax on /metrics and in /debug/slo")
     p.add_argument("--plan-ring-size", type=int, default=None,
                    help="retained misestimated EXPLAIN ANALYZE plans "
                         "(GET /debug/plans; default 128, 0 disables "

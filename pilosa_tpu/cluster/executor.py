@@ -617,6 +617,14 @@ class ClusterExecutor:
         get_pool().map_ordered(
             lambda item: run_node_traced(*item), list(by_node.items()))
 
+        # Cross-node trace assembly (?profile=true): each remote leg's
+        # spans stayed on the node that recorded them — without this a
+        # profiled cluster query shows the fan-out span and nothing
+        # underneath it. Pull the peers' slices of the trace and merge
+        # them (skew-corrected) into the active profile. Best-effort and
+        # profile-gated: the default path never gets here with a profile.
+        self._collect_remote_spans(by_node)
+
         if errors:
             from ..exec.stacked import DeadlineExceededError
             from ..server.client import DeadlineExceeded
@@ -645,6 +653,55 @@ class ClusterExecutor:
             eff_call, eff_opt = unwrap_options(call, opt)
             self.local.attach_row_attrs(idx, eff_call, result, eff_opt)
         return result
+
+    def _collect_remote_spans(self, by_node):
+        """Merge remote-leg spans into the active query profile.
+
+        Skew correction (utils/tracing.estimate_skew): a remote node's
+        http span is the child of this coordinator's
+        `cluster.mapReduce.node` span — that request/response envelope
+        brackets the remote clock, NTP-style. The peer fetch runs under
+        with_span(None) so it neither injects trace headers nor adds
+        spans of its own to the trace it is assembling."""
+        from ..utils import profile as profile_mod
+        from ..utils import tracing
+
+        prof = profile_mod.current()
+        if prof is None:
+            return
+        remote_nodes = [n for n in by_node
+                        if n.id != self.cluster.local_id]
+        if not remote_nodes:
+            return
+        trace_id = prof.root.trace_id
+        local_dicts = [s.to_dict() for s in prof.spans_snapshot()]
+        remote_by_node = {}
+        with tracing.with_span(None):
+            for node in remote_nodes:
+                try:
+                    resp = self._client(node).debug_trace(trace_id)
+                except Exception:  # noqa: BLE001 — assembly is best-effort
+                    continue
+                spans = (resp or {}).get("spans") or []
+                if spans:
+                    remote_by_node[node.id] = spans
+        if not remote_by_node:
+            return
+        merged, skew = tracing.merge_remote_spans(
+            local_dicts, remote_by_node)
+        local_ids = {s["spanID"] for s in local_dicts}
+        added = 0
+        for s in merged:
+            if s["spanID"] in local_ids:
+                continue
+            prof.record(tracing.Span.from_dict(s))
+            added += 1
+        # in-process clusters deliver remote spans through the shared span
+        # sink, so `added` can be 0 — the skew estimate is still real
+        prof.set_tag("remote_spans",
+                     {nid: len(s) for nid, s in remote_by_node.items()})
+        prof.set_tag("clock_skew_seconds",
+                     {nid: round(th, 6) for nid, th in skew.items()})
 
     # -- shard discovery -----------------------------------------------------
 

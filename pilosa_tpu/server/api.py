@@ -18,6 +18,7 @@ import numpy as np
 
 from ..cluster.broadcast import MessageType, Serializer
 from ..utils import faultpoints
+from ..utils import incident as incident_mod
 from ..core import FieldOptions, Holder, IndexOptions
 from ..core.field import (
     FIELD_TYPE_BOOL,
@@ -916,6 +917,7 @@ class API:
         if deadline is not None and time.monotonic() >= deadline:
             flightrec.record("query.rejected", index=index_name,
                              reason="deadline_expired")
+            incident_mod.note_deadline_expiry()
             raise GatewayTimeoutError(
                 "request deadline expired before execution")
         # Device-link fail-fast: with the link DOWN a query would wedge
@@ -980,6 +982,7 @@ class API:
         except admission_mod.Expired as e:
             flightrec.record("query.rejected", index=index_name,
                              reason="deadline_expired_in_queue")
+            incident_mod.note_deadline_expiry()
             raise GatewayTimeoutError(str(e)) from e
         except admission_mod.Rejected as e:
             flightrec.record("query.rejected", index=index_name,
@@ -1040,6 +1043,7 @@ class API:
             if isinstance(e, DeadlineExceededError):
                 flightrec.record("query.rejected", index=index_name,
                                  reason="deadline_expired_mid_query")
+                incident_mod.note_deadline_expiry()
                 raise GatewayTimeoutError(str(e)) from e
             raise ApiError(str(e)) from e
         finally:
@@ -1164,6 +1168,49 @@ class API:
         the HTTP layer marks query responses with "stale": true so
         clients know reads may lag the ingest staleness bound."""
         return self._admission is not None and self._admission.serving_stale()
+
+    def debug_trace(self, trace_id, local_only=False):
+        """GET /debug/traces/{trace_id}: one assembled span tree.
+
+        Local spans come from the bounded per-node trace index (plus the
+        InMemoryTracer ring when one is installed). On a cluster
+        coordinator the default form also pulls every peer's slice of
+        the trace (client.debug_trace → the peers' ?local=true form, so
+        the fan-out cannot recurse) and merges it with per-node
+        clock-skew correction — see utils/tracing.estimate_skew."""
+        from ..utils import tracing
+
+        local = tracing.get_trace(trace_id)
+        tracer = tracing.get_tracer()
+        if hasattr(tracer, "to_dicts"):
+            seen = {s["spanID"] for s in local}
+            local += [s for s in tracer.to_dicts()
+                      if s.get("traceID") == trace_id
+                      and s.get("spanID") not in seen]
+        if local_only or self.cluster is None \
+                or len(self.cluster.nodes) <= 1 \
+                or not hasattr(self.executor, "_client"):
+            return {"traceID": trace_id, "found": bool(local),
+                    "spans": local, "tree": tracing.assemble_tree(local)}
+        remote_by_node = {}
+        with tracing.with_span(None):  # don't trace the assembly fetches
+            for node in list(self.cluster.nodes):
+                if node.id == self.cluster.local_id:
+                    continue
+                try:
+                    resp = self.executor._client(node).debug_trace(trace_id)
+                except Exception:  # noqa: BLE001 — assembly is best-effort
+                    continue
+                spans = (resp or {}).get("spans") or []
+                if spans:
+                    remote_by_node[node.id] = spans
+        merged, skew = tracing.merge_remote_spans(local, remote_by_node)
+        return {"traceID": trace_id, "found": bool(merged),
+                "spans": merged,
+                "nodes": {nid: {"spans": len(remote_by_node[nid]),
+                                "clock_skew_seconds": round(th, 6)}
+                          for nid, th in skew.items()},
+                "tree": tracing.assemble_tree(merged)}
 
     def close(self):
         """Release serving-side background state — the ingest merge

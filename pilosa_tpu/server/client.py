@@ -383,6 +383,21 @@ class Client:
             path += f"?limit={int(limit)}"
         return self._request("GET", path)
 
+    def debug_trace(self, trace_id, deadline=2.0):
+        """The peer's LOCAL finished spans for one trace id (the
+        cross-node assembly getter — the coordinator merges these into
+        one tree with skew-corrected timestamps). Short default deadline:
+        assembly is best-effort garnish on a finished query, never worth
+        blocking the response on a slow peer."""
+        return self._request(
+            "GET", f"/debug/traces/{trace_id}?local=true",
+            deadline=deadline)
+
+    def debug_incidents(self):
+        """The peer's postmortem-bundle listing ({"enabled": False} when
+        the node runs without --incident-dir)."""
+        return self._request("GET", "/debug/incidents")
+
     def export_csv(self, index, field, shard):
         data = self._request(
             "GET", f"/export?index={index}&field={field}&shard={shard}")

@@ -155,6 +155,8 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/plans", self._get_debug_plans,
                   args=("limit",)),
             Route("GET", r"/debug/traces", self._get_debug_traces),
+            Route("GET", r"/debug/traces/(?P<trace_id>[^/?]+)",
+                  self._get_debug_trace, args=("local",)),
             Route("GET", r"/debug/flightrecorder",
                   self._get_flightrecorder, args=("limit",)),
             Route("GET", r"/debug/hbm", self._get_debug_hbm,
@@ -177,6 +179,10 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/ingest", self._get_debug_ingest),
             Route("GET", r"/debug/faultpoints", self._get_faultpoints),
             Route("POST", r"/debug/faultpoints", self._post_faultpoints),
+            Route("GET", r"/debug/incidents", self._get_debug_incidents),
+            Route("GET", r"/debug/incidents/(?P<incident_id>[^/?]+)",
+                  self._get_debug_incident),
+            Route("GET", r"/debug/threads", self._get_threads),
             Route("GET", r"/debug/pprof/goroutine", self._get_threads),
             Route("POST", r"/debug/pprof/profile/start",
                   self._profile_start),
@@ -716,12 +722,46 @@ class PilosaHTTPServer:
         from ..utils import tracing
 
         tracer = tracing.get_tracer()
+        index_stats = tracing.trace_index().stats()
         if isinstance(tracer, tracing.InMemoryTracer):
             return {"enabled": True, "maxSpans": tracer.max_spans,
+                    "traceIndex": index_stats,
                     "spans": tracer.to_dicts()}
         return {"enabled": False, "spans": [],
+                "traceIndex": index_stats,
                 "hint": "run the server with --tracing memory to retain "
-                        "spans"}
+                        "spans; profiled queries land in the trace index "
+                        "either way (GET /debug/traces/{trace_id})"}
+
+    def _get_debug_trace(self, req):
+        """One assembled trace: this node's spans merged with every
+        peer's (skew-corrected) unless ?local=true — the local form is
+        what peers serve to the assembling coordinator, so assembly
+        cannot recurse."""
+        local_only = (self._q1(req, "local", "") or "").lower() \
+            in ("1", "true", "yes")
+        return self.api.debug_trace(req.params["trace_id"],
+                                    local_only=local_only)
+
+    def _get_debug_incidents(self, req):
+        """Postmortem bundle listing: trigger counters + every retained
+        bundle's metadata ({"enabled": false} without --incident-dir)."""
+        from ..utils import incident as incident_mod
+
+        return incident_mod.snapshot()
+
+    def _get_debug_incident(self, req):
+        """One postmortem bundle with its files inlined."""
+        from ..utils import incident as incident_mod
+
+        mgr = incident_mod.get_manager()
+        if mgr is None:
+            raise NotFoundError(
+                "incident bundles disabled (start with --incident-dir)")
+        out = mgr.get(req.params["incident_id"])
+        if out is None:
+            raise NotFoundError("no such incident bundle")
+        return out
 
     def _get_flightrecorder(self, req):
         """The black-box event ring: the last N things this process did
@@ -787,7 +827,12 @@ class PilosaHTTPServer:
                        "timing summaries",
         "/debug/queries": "recent per-query profiles (span tree + "
                           "dispatch/lock/cache counters), newest first",
-        "/debug/traces": "retained raw spans (needs --tracing memory)",
+        "/debug/traces": "retained raw spans (needs --tracing memory) + "
+                         "trace-index stats",
+        "/debug/traces/{trace_id}": "ONE assembled trace: coordinator + "
+                                    "peer spans merged into a tree with "
+                                    "per-node clock-skew correction "
+                                    "(?local=true for this node only)",
         "/debug/plans": "misestimated EXPLAIN ANALYZE plans, deduped "
                         "per query fingerprint, newest first",
         "/debug/hbm": "HBM ledger: resident stack-cache bytes per "
@@ -825,6 +870,11 @@ class PilosaHTTPServer:
                                  "cache churn, stalls, alerts)",
         "/debug/faultpoints": "fault-injection points (GET state, POST "
                               "to arm)",
+        "/debug/incidents": "anomaly-triggered postmortem bundles "
+                            "(flightrec + stacks + debug snapshots), "
+                            "newest first; /debug/incidents/{id} inlines "
+                            "one bundle",
+        "/debug/threads": "all-thread stack dump (text)",
         "/debug/pprof/goroutine": "all-thread stack dump",
     }
 
@@ -1128,6 +1178,8 @@ class PilosaHTTPServer:
             "application/json"
         extra_headers = None  # e.g. Retry-After on a 503
         matched = None  # Route whose pattern labels this request's metrics
+        trace_id = None  # histogram-exemplar link; the span ends before
+        # the timing below is recorded, so capture its id inside the with
         for route in self.routes:
             if route.method != handler.command:
                 continue
@@ -1164,6 +1216,7 @@ class PilosaHTTPServer:
                     status, payload = 500, {"error": str(e)}
                 if span is not None:
                     span.set_tag("status", status)
+                    trace_id = span.trace_id
             break
 
         if isinstance(payload, (dict, list)) or payload is None:
@@ -1192,7 +1245,8 @@ class PilosaHTTPServer:
             tags = {"route": matched.pattern if matched else "unmatched",
                     "method": handler.command, "status": str(status)}
             self.stats.timing(
-                "http_request_seconds", _time.perf_counter() - t0, tags)
+                "http_request_seconds", _time.perf_counter() - t0, tags,
+                trace_id=trace_id)
             if status >= 400:
                 self.stats.count("http_errors", 1, tags)
             if status >= 500:

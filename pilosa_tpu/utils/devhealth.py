@@ -289,6 +289,12 @@ class DeviceLinkProber:
         from . import flightrec as _flightrec
 
         _flightrec.record("devhealth.transition", **evt)
+        if new == DOWN:
+            # edge-triggered postmortem: capture the process state the
+            # moment the link dies, not when an operator shows up
+            from . import incident as _incident
+
+            _incident.maybe_trigger("devhealth_down", **evt)
         if self.logger is not None:
             try:
                 self.logger.error(

@@ -311,6 +311,25 @@ class Watchdog:
             "(deadline %.3fs) device_link=%s tags=%s\n%s\n%s",
             op.kind, op.thread, overdue, op.deadline, link_state, op.tags,
             _recorder.format_tail(), format_all_stacks())
+        from . import incident as _incident
+
+        # evt's "kind" is the stalled OP's kind — rename so it cannot
+        # collide with the trigger kind parameter
+        _incident.maybe_trigger(
+            "watchdog_stall",
+            **{("op" if k == "kind" else k): v for k, v in evt.items()})
+
+    def open_ops(self, now=None):
+        """Snapshot of every in-flight op (incident bundles + debug):
+        what was holding the dispatch lock / running a query at the
+        moment of the anomaly."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ops = list(self._ops.values())
+        return [dict(op.tags, kind=op.kind, thread=op.thread,
+                     running_seconds=round(now - op.start, 3),
+                     deadline_seconds=op.deadline, tripped=op.tripped)
+                for op in ops]
 
     def _loop(self):
         while not self._stop.wait(self.poll_interval):
@@ -392,6 +411,14 @@ def install_crash_handler(logger=None):
             dump(logger, reason="SIGTERM")
         except Exception:  # noqa: BLE001 — never mask the shutdown
             pass
+        try:
+            # synchronous: the process is dying, there is no later
+            from . import incident as _incident
+
+            _incident.maybe_trigger("fatal_signal", sync=True,
+                                    signal="SIGTERM")
+        except Exception:  # noqa: BLE001 — never mask the shutdown
+            pass
         if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
             prev(signum, frame)
         else:
@@ -427,6 +454,12 @@ class _DebugHandler(http.server.BaseHTTPRequestHandler):
 
             body = json.dumps(
                 {"phases": global_dispatch_phases()}).encode()
+        elif path == "/debug/incidents":
+            # the bench parent attaches the newest bundle path to a
+            # failed attempt's record (see bench.py _run_attempt)
+            from . import incident as _incident
+
+            body = json.dumps(_incident.snapshot()).encode()
         else:
             self.send_error(404)
             return

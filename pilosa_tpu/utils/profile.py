@@ -93,6 +93,12 @@ class QueryProfile:
         with self._lock:
             return self._tags.get(key, default)
 
+    def spans_snapshot(self):
+        """Finished spans recorded so far (cross-node assembly reads the
+        local fan-out spans from here to estimate per-node clock skew)."""
+        with self._lock:
+            return list(self._spans)
+
     # -- lifecycle -----------------------------------------------------------
 
     def begin(self):
@@ -106,6 +112,10 @@ class QueryProfile:
         self.root.finish()
         self.duration = self.root.duration
         _active.pop(self.root.trace_id, None)
+        # the root span bypasses start_span, so index it here — this is
+        # what lets GET /debug/traces/{trace_id} resolve a profiled query
+        # (e.g. from a metrics exemplar) after it finished
+        tracing.index_span(self.root)
         if self.slow_threshold is not None \
                 and self.duration > self.slow_threshold:
             self.slow = True

@@ -15,6 +15,8 @@ import threading
 import time
 from collections import defaultdict
 
+from . import tracing
+
 #: log-spaced latency bucket upper bounds (seconds) shared by every
 #: timing series — 100µs to 10s, ~×2.5 per step, with an implicit +Inf
 #: bucket. Log spacing keeps relative error roughly constant from
@@ -88,6 +90,12 @@ class StatsClient:
         # recency-weighted view the adaptive layer calibrates from.
         self._timings = defaultdict(
             lambda: [0, 0.0, [0] * (len(TIMING_BUCKETS) + 1), 0.0])
+        # Exemplars (OpenMetrics): when enabled, each timing series keeps
+        # ONE recent (trace_id, value, wall_ts) per bucket, linking a
+        # histogram bucket straight to an assembled trace. Off by default:
+        # the flag check is the only cost on the disabled path.
+        self._exemplars_on = False
+        self._exemplars = {}  # series key -> [exemplar|None per bucket]
 
     def count(self, name, value=1, tags=None):
         with self._lock:
@@ -105,15 +113,54 @@ class StatsClient:
         with self._lock:
             self._gauge_fns[_key(name, tags)] = fn
 
-    def timing(self, name, seconds, tags=None):
+    def enable_exemplars(self, enabled=True):
         with self._lock:
-            t = self._timings[_key(name, tags)]
+            self._exemplars_on = bool(enabled)
+            if not enabled:
+                self._exemplars.clear()
+
+    def timing(self, name, seconds, tags=None, trace_id=None):
+        k = _key(name, tags)
+        i = bisect.bisect_left(TIMING_BUCKETS, seconds)
+        with self._lock:
+            t = self._timings[k]
             t[0] += 1
             t[1] += seconds
-            t[2][bisect.bisect_left(TIMING_BUCKETS, seconds)] += 1
+            t[2][i] += 1
             # first sample seeds the EWMA; later samples alpha-blend
             t[3] = seconds if t[0] == 1 \
                 else t[3] + EWMA_ALPHA * (seconds - t[3])
+            if self._exemplars_on:
+                if trace_id is None:
+                    span = tracing.current_span()
+                    trace_id = span.trace_id if span is not None else None
+                if trace_id is not None:
+                    ex = self._exemplars.get(k)
+                    if ex is None:
+                        ex = self._exemplars[k] = \
+                            [None] * (len(TIMING_BUCKETS) + 1)
+                    ex[i] = (trace_id, seconds, time.time())
+
+    def exemplars(self, name=None):
+        """{series key: {le_label: {"traceID","value","timestamp"}}} for
+        series with at least one exemplar; `name` filters to one family
+        (how /debug/slo links a burning objective to traces)."""
+        with self._lock:
+            items = [(k, list(v)) for k, v in self._exemplars.items()
+                     if name is None or k[0] == name]
+        out = {}
+        for k, buckets in items:
+            per = {}
+            for i, e in enumerate(buckets):
+                if e is None:
+                    continue
+                le = (f"{TIMING_BUCKETS[i]:g}"
+                      if i < len(TIMING_BUCKETS) else "+Inf")
+                per[le] = {"traceID": e[0], "value": e[1],
+                           "timestamp": e[2]}
+            if per:
+                out[k] = per
+        return out
 
     def snapshot(self):
         """(counters, gauges, timings) — timings as (count, sum) pairs;
@@ -172,8 +219,22 @@ class StatsClient:
         (_bucket{le=...}/_count/_sum) for timings."""
         counters, gauges, _ = self.snapshot()
         hists = self.histograms()
+        with self._lock:
+            exemplars = {k: list(v) for k, v in self._exemplars.items()}
         lines = []
         seen_families = set()
+
+        def exemplar_suffix(key, bucket_i):
+            # OpenMetrics exemplar: `value # {trace_id="..."} v ts`.
+            # Exemplar-aware scrapers (and humans) get the trace link;
+            # plain Prometheus text parsers that reject it simply should
+            # not enable --metrics-exemplars.
+            ex = exemplars.get(key)
+            if not ex or ex[bucket_i] is None:
+                return ""
+            tid, v, ts = ex[bucket_i]
+            return (f' # {{trace_id="{_escape_label(tid)}"}}'
+                    f" {v:g} {ts:.3f}")
 
         def family(fqname, typ):
             # dedupe: one TYPE line per family, before its first sample
@@ -200,13 +261,16 @@ class StatsClient:
         for (name, labels), (count, total, buckets) in sorted(hists.items()):
             fq = f"pilosa_tpu_{name}"
             family(fq, "histogram")
+            key = (name, labels)
             cum = 0
-            for bound, n in zip(TIMING_BUCKETS, buckets):
+            for i, (bound, n) in enumerate(zip(TIMING_BUCKETS, buckets)):
                 cum += n
                 lines.append(fmt(f"{fq}_bucket", labels, cum,
-                                 extra=(("le", f"{bound:g}"),)))
+                                 extra=(("le", f"{bound:g}"),))
+                             + exemplar_suffix(key, i))
             lines.append(fmt(f"{fq}_bucket", labels, count,
-                             extra=(("le", "+Inf"),)))
+                             extra=(("le", "+Inf"),))
+                         + exemplar_suffix(key, len(TIMING_BUCKETS)))
             lines.append(fmt(f"{fq}_count", labels, count))
             lines.append(fmt(f"{fq}_sum", labels, total))
         return "\n".join(lines) + "\n"
@@ -244,7 +308,7 @@ class NopStats:
     def gauge(self, name, value, tags=None):
         pass
 
-    def timing(self, name, seconds, tags=None):
+    def timing(self, name, seconds, tags=None, trace_id=None):
         pass
 
 
@@ -278,7 +342,7 @@ class StatsDClient:
     def gauge(self, name, value, tags=None):
         self._send(name, value, "g", tags)
 
-    def timing(self, name, seconds, tags=None):
+    def timing(self, name, seconds, tags=None, trace_id=None):
         self._send(name, round(seconds * 1000, 3), "ms", tags)
 
     def close(self):
@@ -300,9 +364,9 @@ class MultiStats:
         for c in self.clients:
             c.gauge(name, value, tags)
 
-    def timing(self, name, seconds, tags=None):
+    def timing(self, name, seconds, tags=None, trace_id=None):
         for c in self.clients:
-            c.timing(name, seconds, tags)
+            c.timing(name, seconds, tags, trace_id=trace_id)
 
 
 class RuntimeMonitor:
@@ -423,6 +487,14 @@ def build_stats(kind, statsd_host=None, registry=None):
         return MultiStats(
             [registry, StatsDClient(host, int(port or 8125))])
     raise ValueError(f"unknown stats backend {kind!r}")
+
+
+def configure_exemplars(enabled, registry=None):
+    """Toggle histogram exemplar capture on the exposition registry
+    (--metrics-exemplars). Nop-cheap when off: one flag check per
+    timing() call."""
+    (registry if registry is not None else global_stats) \
+        .enable_exemplars(enabled)
 
 
 global_stats = StatsClient()

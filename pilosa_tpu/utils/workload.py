@@ -570,6 +570,12 @@ class SloEngine:
                 burn_slow=round(burns["slow"], 2),
                 threshold=self.burn_threshold)
             self._stats.count("slo_burn_alerts", 1, {"objective": o.name})
+            from . import incident
+
+            incident.maybe_trigger(
+                "slo_burn", objective=o.name, spec=o.spec(),
+                burn_fast=round(burns["fast"], 2),
+                burn_slow=round(burns["slow"], 2))
         with self._lock:
             return dict(self._burns)
 
@@ -590,9 +596,42 @@ class SloEngine:
             return 0.0
         return (d_bad / d_total) / objective.budget
 
+    def _exemplars_for(self, objective):
+        """Over-threshold histogram exemplars for one objective — the
+        direct link from a burning objective to assembled traces
+        (GET /debug/traces/{traceID}). Empty unless the registry has
+        exemplar capture enabled (--metrics-exemplars)."""
+        from .stats import registry_of
+
+        reg = registry_of(self._stats)
+        if not hasattr(reg, "exemplars"):
+            return []
+        name = objective.name
+        family, op = "query_op_seconds", None
+        if name == "http":
+            family = "http_request_seconds"
+        elif name.startswith("query."):
+            op = name.split(".", 1)[1]
+        elif name != "query":
+            family = name
+        out = []
+        for (_fam, tags), per in reg.exemplars(family).items():
+            if op is not None and ("op", op) not in tags:
+                continue
+            for le, e in per.items():
+                if e["value"] > objective.threshold_seconds:
+                    out.append({"traceID": e["traceID"],
+                                "seconds": round(e["value"], 6),
+                                "le": le, "tags": dict(tags),
+                                "timestamp": e["timestamp"]})
+        out.sort(key=lambda e: -e["seconds"])
+        return out[:8]
+
     def snapshot(self):
         """GET /debug/slo."""
         burns = self.sample()
+        exemplars = {o.name: self._exemplars_for(o)
+                     for o in list(self.objectives)}
         with self._lock:
             out = {
                 "windows": {"fast_seconds": SLO_FAST_WINDOW,
@@ -604,7 +643,7 @@ class SloEngine:
             for o in self.objectives:
                 ring = self._samples.get(o.name) or []
                 tip = ring[-1] if ring else (0.0, 0, 0)
-                out["objectives"].append({
+                entry = {
                     "name": o.name,
                     "spec": o.spec(),
                     "threshold_ms": round(o.threshold_seconds * 1000, 3),
@@ -616,7 +655,10 @@ class SloEngine:
                         k: round(v, 4)
                         for k, v in burns.get(o.name, {}).items()},
                     "alerting": self._alerting.get(o.name, False),
-                })
+                }
+                if exemplars.get(o.name):
+                    entry["exemplars"] = exemplars[o.name]
+                out["objectives"].append(entry)
         return out
 
     def summary(self):

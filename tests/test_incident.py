@@ -1,0 +1,413 @@
+"""Incident autopsy: cross-node trace assembly, anomaly-triggered
+postmortem bundles, and metrics exemplars.
+
+Covers the three tentpole surfaces end to end — a live 2-node profiled
+query whose span tree merges remote spans with skew correction, forced
+anomaly signals (devhealth DOWN, deadline storm) writing bundles served
+at /debug/incidents, and OpenMetrics exemplars on /metrics that resolve
+through GET /debug/traces/{trace_id} — plus the satellite fixes
+(monotonic span durations, /debug/threads, MAX_PROFILE_SPANS overflow
+accounting under concurrent finishes).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import devhealth, incident, profile, stats, tracing
+
+from .harness import ClusterHarness, ServerHarness
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability_state():
+    """These tests finish profiles and index spans on the test thread;
+    drain the thread-local take_last stash, the recent ring, and the
+    global trace index so later suites see the pristine default state."""
+    yield
+    profile.take_last()
+    profile.clear_recent()
+    tracing.trace_index().clear()
+
+
+@pytest.fixture
+def tracer():
+    t = tracing.InMemoryTracer()
+    tracing.set_tracer(t)
+    yield t
+    tracing.set_tracer(None)
+
+
+@pytest.fixture
+def manager(tmp_path):
+    mgr = incident.configure(str(tmp_path / "incidents"), min_interval=0.0)
+    yield mgr
+    incident.stop()
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(0.02)
+    return cond()
+
+
+def _flatten(node):
+    out = [node]
+    for c in node["children"]:
+        out.extend(_flatten(c))
+    return out
+
+
+# -- tentpole 1: cross-node trace assembly -----------------------------------
+
+
+def test_cross_node_profile_assembly():
+    """A profiled fan-out query returns ONE merged span tree: the
+    coordinator's spans plus the remote leg's server-side spans, with
+    correct parentage and skew-corrected (sane) timestamps."""
+    ch = ClusterHarness(2)
+    try:
+        coord = ch.non_owner_of("ti", 0)
+        ch[0].client.create_index("ti")
+        ch[0].client.create_field("ti", "f")
+        ch[0].client.import_bits("ti", "f", [10, 10], [5, SHARD_WIDTH + 5])
+
+        resp = coord.client.query("ti", "Count(Row(f=10))", profile=True)
+        assert resp["results"] == [2]
+        prof = resp["profile"]
+        spans = _flatten(prof["spans"])
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+
+        # the coordinator's fan-out span exists and the remote leg's
+        # server-side spans were merged beneath it
+        assert "cluster.mapReduce.node" in by_name
+        fanout = by_name["cluster.mapReduce.node"][0]
+        remote_names = {s["name"] for s in _flatten(fanout)}
+        assert "api.Query" in remote_names       # remote server span
+        assert "executor.Execute" in remote_names
+        # parentage: the remote http server span nests under the fan-out
+        # client span, not under the root
+        assert any(c["name"].startswith("http.POST")
+                   for c in fanout["children"])
+
+        # assembly metadata: per-node skew + span counts
+        assert "clock_skew_seconds" in prof["tags"]
+        assert "remote_spans" in prof["tags"]
+        skews = prof["tags"]["clock_skew_seconds"]
+        assert len(skews) == 1
+        # in-process "nodes" share a clock: corrected skew is tiny
+        assert abs(next(iter(skews.values()))) < 1.0
+
+        # skew-corrected timestamps are sane: every span starts within
+        # the query's own wall-clock envelope (loose 5s slop)
+        for s in spans:
+            if s.get("start") is not None:
+                assert abs(s["start"] - prof["start"]) < 5.0
+
+        # GET /debug/traces/{id}: peer-serving local form and the
+        # cluster-assembled form both resolve the profiled trace
+        tid = prof["traceID"]
+        local = coord.client.debug_trace(tid)
+        assert local["found"] and local["spans"]
+        full = coord.client._request("GET", f"/debug/traces/{tid}")
+        assert full["found"]
+        assert len(full["spans"]) >= len(local["spans"])
+        assert full["nodes"]
+        node_info = next(iter(full["nodes"].values()))
+        assert node_info["spans"] > 0
+        assert "clock_skew_seconds" in node_info
+        assert full["tree"]  # assembled forest, roots present
+    finally:
+        ch.close()
+
+
+def test_estimate_skew_and_merge():
+    """NTP-style offset: remote request/response bracketed by the local
+    client span recovers the clock offset exactly on synthetic data."""
+    local = {"name": "http.POST", "traceID": "t", "spanID": "L",
+             "parentID": None, "tags": {}, "start": 100.0, "duration": 0.2}
+    remote = {"name": "api.Query", "traceID": "t", "spanID": "R",
+              "parentID": "L", "tags": {}, "start": 150.05, "duration": 0.1}
+    theta = tracing.estimate_skew([local], [remote])
+    assert theta == pytest.approx(50.0, abs=1e-9)
+
+    merged, skew = tracing.merge_remote_spans([local], {"n1": [remote]})
+    assert skew["n1"] == pytest.approx(50.0, abs=1e-9)
+    shifted = [s for s in merged if s["spanID"] == "R"][0]
+    assert shifted["start"] == pytest.approx(100.05, abs=1e-9)
+    assert shifted["tags"]["node"] == "n1"
+    # durations are never adjusted — they are monotonic-clock truth
+    assert shifted["duration"] == 0.1
+
+    # no pairing -> merge uncorrected rather than not at all
+    orphan = dict(remote, parentID="nope", spanID="R2")
+    assert tracing.estimate_skew([local], [orphan]) == 0.0
+
+    tree = tracing.assemble_tree(merged)
+    assert len(tree) == 1 and tree[0]["spanID"] == "L"
+    assert tree[0]["children"][0]["spanID"] == "R"
+
+
+def test_trace_index_bounds_and_eviction():
+    idx = tracing.TraceIndex(max_traces=2, max_spans_per_trace=3)
+    for t in ("t1", "t2", "t3"):
+        for i in range(5):
+            s = tracing.Span("s%d" % i, t, "%s-%d" % (t, i), None, {})
+            s.finish()
+            idx.add(s)
+    st = idx.stats()
+    assert st["traces"] == 2
+    assert st["evictedTraces"] == 1         # t1 evicted by t3
+    assert st["droppedSpans"] == 3 * 2      # 2 spans over cap per trace
+    assert idx.get("t1") == []
+    got = idx.get("t3")
+    assert len(got) == 3
+
+
+def test_profile_finish_indexes_root_span(tracer):
+    """A finished profile's trace id resolves via the trace index (this
+    is what makes metrics exemplars clickable after the query ends)."""
+    prof = profile.begin("i", "Count(Row(f=1))")
+    snap = prof.finish()
+    got = tracing.get_trace(snap["traceID"])
+    assert got and got[0]["name"] == "query"
+
+
+# -- satellite 1: monotonic durations ----------------------------------------
+
+
+def test_span_duration_survives_wall_clock_step():
+    """Durations come from the monotonic clock: rewinding the wall-clock
+    start (as an NTP step would) cannot produce hour-long durations."""
+    s = tracing.Span("x", "t", "s", None, {})
+    s.start -= 3600.0  # simulate a backwards NTP step after span start
+    s.finish()
+    assert 0.0 <= s.duration < 60.0
+
+
+# -- satellite 3: MAX_PROFILE_SPANS overflow accounting ----------------------
+
+
+def test_profile_span_overflow_concurrent():
+    """Concurrent span finishes past MAX_PROFILE_SPANS: exactly the cap
+    is retained and every overflow is counted in spansDropped."""
+    tracing.set_tracer(None)  # overflow must be exercised on the nop path
+    prof = profile.begin("i", "q")
+    threads_n, per_thread = 8, 100
+    total = threads_n * per_thread
+    assert total > profile.MAX_PROFILE_SPANS
+    start = threading.Barrier(threads_n)
+
+    def worker():
+        start.wait()
+        # a real parent forces start_span to allocate even under the nop
+        # tracer; each finish routes through the span sink to the profile
+        with tracing.with_span(prof.root):
+            for _ in range(per_thread):
+                with tracing.start_span("w"):
+                    pass
+
+    ts = [threading.Thread(target=worker) for _ in range(threads_n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = prof.finish()
+    assert snap["spansDropped"] == total - profile.MAX_PROFILE_SPANS
+    kept = len(_flatten(snap["spans"])) - 1  # minus the root itself
+    assert kept == profile.MAX_PROFILE_SPANS
+
+
+# -- tentpole 2: anomaly-triggered postmortem bundles ------------------------
+
+
+def test_devhealth_down_writes_bundle(manager):
+    """The acceptance path: a forced device-link DOWN transition writes
+    a bundle that GET /debug/incidents lists."""
+
+    def bad():
+        raise RuntimeError("induced canary failure")
+
+    try:
+        p = devhealth.configure(canary=bad, down_after=2, start=False)
+        p.probe_once()
+        p.probe_once()
+        assert p.state == devhealth.DOWN
+        bundles = _wait_for(manager.list)
+        assert bundles, "DOWN transition did not write a bundle"
+        meta = bundles[0]
+        assert meta["kind"] == "devhealth_down"
+        assert "flightrec.json" in meta["files"]
+        assert "threads.txt" in meta["files"]
+        assert "device.json" in meta["files"]
+
+        got = manager.get(meta["id"])
+        assert got["contents"]["device.json"]["state"] == devhealth.DOWN
+        assert "MainThread" in got["contents"]["threads.txt"]
+        assert got["trigger"]["to"] == devhealth.DOWN
+    finally:
+        devhealth.stop()
+
+
+def test_deadline_storm_triggers_bundle(tmp_path):
+    mgr = incident.IncidentManager(str(tmp_path), min_interval=0.0,
+                                   storm_count=5, storm_window=30.0)
+    for _ in range(4):
+        mgr.note_deadline_expiry()
+    assert mgr.list() == []  # below the edge: no bundle
+    mgr.note_deadline_expiry()
+    bundles = _wait_for(mgr.list)
+    assert bundles and bundles[0]["kind"] == "deadline_storm"
+    assert bundles[0]["trigger"]["count"] == 5
+
+
+def test_refractory_suppression(tmp_path):
+    mgr = incident.IncidentManager(str(tmp_path), min_interval=300.0)
+    assert mgr.trigger("manual", sync=True) is not None
+    assert mgr.trigger("manual", sync=True) is None  # rate-limited
+    assert mgr.suppressed_total == 1
+    # a different kind has its own refractory clock
+    assert mgr.trigger("watchdog_stall", sync=True) is not None
+
+
+def test_retention_cap(tmp_path):
+    mgr = incidents = incident.IncidentManager(
+        str(tmp_path), max_incidents=3, min_interval=0.0)
+    for i in range(5):
+        assert mgr.trigger("manual", sync=True, n=i) is not None
+    got = incidents.list()
+    assert len(got) == 3
+    assert [m["trigger"]["n"] for m in got] == [4, 3, 2]  # newest kept
+
+
+def test_bundle_get_rejects_traversal(manager):
+    manager.trigger("manual", sync=True)
+    assert manager.get("../" + manager.list()[0]["id"]) is None
+    assert manager.get("..") is None
+
+
+def test_collector_failure_isolated(manager):
+    manager.register_collector("boom", lambda: 1 / 0)
+    manager.register_collector("ok", lambda: {"fine": True})
+    manager.trigger("manual", sync=True)
+    got = manager.get(manager.list()[0]["id"])
+    assert "error" in got["contents"]["boom.json"]
+    assert got["contents"]["ok.json"] == {"fine": True}
+
+
+def test_disabled_default_snapshot():
+    incident.stop()
+    snap = incident.snapshot()
+    assert snap["enabled"] is False
+    # hooks are nops without a manager — must not raise
+    assert incident.maybe_trigger("manual") is None
+    incident.note_deadline_expiry()
+
+
+def test_incident_http_endpoints(tmp_path, manager):
+    h = ServerHarness()
+    try:
+        manager.trigger("manual", sync=True, note="from-test")
+        snap = h.client.debug_incidents()
+        assert snap["enabled"] is True
+        assert snap["written_total"] == 1
+        iid = snap["incidents"][0]["id"]
+
+        got = h.client._request("GET", f"/debug/incidents/{iid}")
+        assert got["kind"] == "manual"
+        assert got["trigger"]["note"] == "from-test"
+        assert "flightrec.json" in got["contents"]
+
+        with pytest.raises(Exception):
+            h.client._request("GET", "/debug/incidents/nope")
+
+        # satellite: /debug/threads stack dump + debug index listing
+        text = urllib.request.urlopen(
+            h.address + "/debug/threads", timeout=5).read().decode()
+        assert "MainThread" in text
+        index = h.client._request("GET", "/debug")
+        paths = {e["path"] for e in index["endpoints"]}
+        assert "/debug/incidents" in paths
+        assert "/debug/threads" in paths
+        assert "/debug/traces/{trace_id}" in paths
+    finally:
+        h.close()
+
+
+# -- tentpole 3: metrics exemplars -------------------------------------------
+
+
+def test_exemplars_unit():
+    c = stats.StatsClient()
+    c.timing("query_seconds", 0.05, trace_id="deadbeef")
+    assert c.exemplars() == {}  # off by default: nothing retained
+    c.enable_exemplars(True)
+    c.timing("query_seconds", 0.05, trace_id="deadbeef")
+    c.timing("query_seconds", 2.5, {"op": "count"}, trace_id="cafe01")
+    ex = c.exemplars("query_seconds")
+    flat = {e["traceID"] for by_bucket in ex.values()
+            for e in by_bucket.values()}
+    assert flat == {"deadbeef", "cafe01"}
+    text = c.prometheus_text()
+    assert '# {trace_id="deadbeef"} 0.05' in text
+    assert '# {trace_id="cafe01"} 2.5' in text
+    c.enable_exemplars(False)
+    assert c.exemplars() == {}  # disable clears
+    assert "# {" not in c.prometheus_text()
+
+
+def test_slo_snapshot_attaches_exemplars():
+    """/debug/slo links a burning objective straight to traces: only
+    over-threshold exemplars are attached, sorted worst-first."""
+    from pilosa_tpu.utils import workload
+
+    sc = stats.StatsClient()
+    sc.enable_exemplars(True)
+    eng = workload.SloEngine(stats=sc)
+    eng.configure([workload.parse_slo("query=10ms@p99")])
+    sc.timing("query_op_seconds", 0.5, {"op": "count"}, trace_id="aa11")
+    sc.timing("query_op_seconds", 0.002, {"op": "count"}, trace_id="bb22")
+    obj = eng.snapshot()["objectives"][0]
+    assert [e["traceID"] for e in obj["exemplars"]] == ["aa11"]
+    assert obj["exemplars"][0]["seconds"] == pytest.approx(0.5)
+    # exemplars off -> the key is simply absent
+    sc.enable_exemplars(False)
+    assert "exemplars" not in eng.snapshot()["objectives"][0]
+
+
+def test_metrics_exemplar_resolves_via_trace(tracer):
+    """Acceptance: /metrics emits an exemplar whose trace id resolves to
+    a span tree via GET /debug/traces/{trace_id}."""
+    h = ServerHarness()
+    reg = stats.registry_of(h.server.stats)
+    try:
+        reg.enable_exemplars(True)
+        h.client.create_index("ex")
+        h.client.create_field("ex", "f")
+        h.client.query("ex", "Set(1, f=3)")
+
+        text = urllib.request.urlopen(
+            h.address + "/metrics", timeout=5).read().decode()
+        m = re.search(
+            r'http_request_seconds_bucket\{[^}]*\}\s+\d+\s+'
+            r'# \{trace_id="([0-9a-f]+)"\}', text)
+        assert m, "no http_request_seconds exemplar on /metrics"
+        tid = m.group(1)
+
+        out = h.client.debug_trace(tid)
+        assert out["found"]
+        assert any(s["name"].startswith("http.") for s in out["spans"])
+    finally:
+        reg.enable_exemplars(False)
+        h.close()
