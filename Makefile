@@ -16,12 +16,13 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 .PHONY: test test-core test-distributed test-observability test-parallel \
 	test-flightrec test-devhealth test-explain test-durability \
 	test-workload test-batching test-containers test-adaptive \
-	test-ingest test-admission test-fusion test-incident lint bench-cpu
+	test-ingest test-admission test-fusion test-incident \
+	test-spmd-mesh lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
 	test-containers test-adaptive test-ingest test-admission \
-	test-fusion test-incident
+	test-fusion test-incident test-spmd-mesh
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -117,6 +118,16 @@ test-fusion:
 # exemplars, and the /debug/traces//incidents/threads endpoints.
 test-incident:
 	$(PY) -m pytest tests/test_incident.py $(PYTEST_FLAGS)
+
+# Mesh-resident SPMD serving surface: the fast in-process units plus the
+# 2-process gloo CPU mesh (marked slow, so deliberately NOT filtered by
+# -m 'not slow' here): on==off==http bit-exactness over the query mix,
+# K-coalesced Counts as ONE collective step, warm fused queries with
+# zero HTTP result bytes, step-stream lifecycle counters, and ?explain
+# mesh plans.
+test-spmd-mesh:
+	$(PY) -m pytest tests/test_spmd_mesh.py tests/test_spmd_serve.py \
+		-q -p no:cacheprovider
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

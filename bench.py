@@ -118,6 +118,16 @@ def _ingest_mode():
         return None
 
 
+def _spmd_mode():
+    """SPMD serve mode the numbers were measured under ("off"/"on"/
+    "shadow"/"http") — a mesh-collective run pays one collective step
+    per batch, an HTTP fan-out run pays one POST per shard owner, so
+    serving comparisons must be like-for-like on the data plane too.
+    The in-process bench child runs no cluster, so this reads the env
+    the orchestrator (or the spmd_serving suite leg) set for the run."""
+    return os.environ.get("PILOSA_TPU_SPMD_SERVE", "off")
+
+
 def _admission_mode():
     """Admission mode ("off" or "on state=<rung>") tagged into every
     emitted record — a run measured while the degradation ladder was
@@ -356,6 +366,9 @@ def main():
             # valid between runs under the same QoS policy, and a run
             # measured while the ladder was shedding is tainted
             "admission_mode": _admission_mode(),
+            # SPMD serve mode: which data plane (mesh collectives vs
+            # HTTP fan-out) the serving numbers were measured on
+            "spmd_mode": _spmd_mode(),
         },
     }))
 
@@ -569,6 +582,14 @@ def _classify_wedge(phase, tail, dev):
                          trip entered the tunnel and never came back
     - tunnel_init_hang — killed before the probe marker with no open
                          dispatch: backend init (jax.devices()) hung
+    - spmd_never_entered — a collective step was announced (step-seq
+                         assigned, fanned out) but this process never
+                         recorded spmd.step_enter for it: a PEER is
+                         stuck, or the stream gapped — the collective
+                         itself never started here
+    - spmd_collective_hung — spmd.step_enter with no matching
+                         spmd.step_exit: every process joined the
+                         collective and the program itself wedged
     - unclassified     — none of the signatures match (real code bug,
                          plain timeout, forensics unreachable)
 
@@ -576,14 +597,27 @@ def _classify_wedge(phase, tail, dev):
     if (dev or {}).get("state") == "DOWN":
         return "tunnel_down"
     open_dispatch = 0
+    announced, entered, exited = set(), set(), 0
+    enters = 0
     for evt in (tail or {}).get("events") or []:
         kind = evt.get("kind")
         if kind == "dispatch.start":
             open_dispatch += 1
         elif kind == "dispatch.end":
             open_dispatch = max(0, open_dispatch - 1)
+        elif kind == "spmd.step_announce":
+            announced.add((evt.get("tags") or {}).get("seq"))
+        elif kind == "spmd.step_enter":
+            entered.add((evt.get("tags") or {}).get("seq"))
+            enters += 1
+        elif kind == "spmd.step_exit":
+            exited += 1
     if open_dispatch > 0:
         return "dispatch_wedge"
+    if enters > exited:
+        return "spmd_collective_hung"
+    if announced - entered:
+        return "spmd_never_entered"
     if phase == "probe":
         return "tunnel_init_hang"
     return "unclassified"

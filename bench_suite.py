@@ -2449,6 +2449,130 @@ def bench_incident_overhead():
         "suppressed_by_refractory": 1})
 
 
+def bench_spmd_serving():
+    """Mesh-resident SPMD serving acceptance leg (config: spmd_serving).
+
+    Three claims, one JSON line, all against the SAME live 2-process
+    gloo cluster (the runtime POST /debug/spmd switch does the A/B, so
+    both arms share processes, page cache, and compiled programs):
+    1. Batched-collective Count throughput under sustained concurrent
+       load (serve on: the coalescer drains into ONE collective step
+       per cycle — one announcement, one vmapped program, one psum —
+       and the step-stream pipelines the next batch while it executes)
+       is >=2x the per-query HTTP fan-out (serve http: same coalescer,
+       legacy data plane).
+    2. During the on-mode window, ZERO result bytes move over the HTTP
+       data plane on ANY node (client byte accounting: results ride
+       the psum, HTTP carries control only).
+    3. The disabled path stays free: with --spmd-serve off the only
+       per-query hooks are the fused-entry decline and the coalescer
+       gate probe, measured <2% of an api_nop query wall even charged
+       at one full set per query.
+    """
+    import importlib
+    import sys as _sys
+
+    from pilosa_tpu.cluster.spmd import SpmdDataPlane
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    # -- claim 3 first (in-process, fast-fail): disabled-path hooks ------
+    platform, holder, api, ex = _env()
+    api.create_index("sboff")
+    api.create_field("sboff", "a")
+    idx = holder.index("sboff")
+    rng = np.random.default_rng(18)
+    cols = rng.choice(2 * SHARD_WIDTH, size=50_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    api.executor = ex
+    pql = "Count(Row(a=1))"
+    api.query("sboff", pql)  # warm stacks + compile
+    n_q = 50 if platform == "cpu" else 200
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("sboff", pql)
+    query_ms = (time.perf_counter() - t0) / n_q * 1000
+
+    plane = SpmdDataPlane(None, None, None, serve_mode="off")
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        plane.maybe_execute_fused(None, None, None)  # executor hook
+        _ = plane.serve_mode != "off"  # coalescer activation gate
+    per_q_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    overhead_pct = per_q_ns / 1e6 / query_ms * 100
+    _close(holder)
+    assert overhead_pct < 2.0, (
+        f"disabled --spmd-serve hooks cost {overhead_pct:.3f}% of an "
+        "api_nop query — no longer an off-by-default-safe data plane")
+
+    # -- claims 1 + 2: live 2-process gloo mesh, same-cluster A/B --------
+    _sys.path.insert(0, ".")
+    harness = importlib.import_module("tests.harness")
+    cluster = harness.SpmdMeshCluster(2, coalesce_window="10ms")
+    try:
+        cluster.wait_ready()
+        coord = cluster.clients[cluster.coord]
+        coord.create_index("sb")
+        coord.create_field("sb", "f")
+        time.sleep(1.0)  # DDL broadcast settles
+        n_shards, rows = 4, 8
+        expected = []
+        for r in range(rows):
+            bits = [s * SHARD_WIDTH + i
+                    for s in range(n_shards) for i in range(100 + 10 * r)]
+            coord.import_bits("sb", "f", [r] * len(bits), bits)
+            expected.append(len(bits))
+        def run_one(i):
+            r = i % rows
+            got = coord.query("sb", f"Count(Row(f={r}))")["results"][0]
+            assert got == expected[r], (r, got, expected[r])
+
+        n_meas = 160
+        cluster.set_mode("on")
+        _measure_qps(run_one, 2 * rows)  # warm: cache + programs + epochs
+        _measure_qps(run_one, 2 * rows)
+        cluster.set_mode("http")
+        _measure_qps(run_one, rows)
+        http_qps = _measure_qps(run_one, n_meas)
+
+        cluster.set_mode("on")
+        _measure_qps(run_one, rows)
+        before = [cluster.debug(i) for i in range(2)]
+        on_qps = _measure_qps(run_one, n_meas)
+        after = [cluster.debug(i) for i in range(2)]
+    finally:
+        cluster.close()
+
+    byte_deltas = [a["http_data_plane_bytes"] - b["http_data_plane_bytes"]
+                   for a, b in zip(after, before)]
+    assert all(d == 0 for d in byte_deltas), (
+        f"result bytes leaked onto the HTTP data plane: {byte_deltas}")
+    ci = cluster.coord
+    d_batched = (after[ci]["queries"]["batched"]
+                 - before[ci]["queries"]["batched"])
+    d_steps = (after[ci]["steps"]["run"] - before[ci]["steps"]["run"])
+    speedup = on_qps / http_qps if http_qps else 0
+    assert speedup >= 2.0, (
+        f"batched-collective serving only {speedup:.2f}x the HTTP "
+        "fan-out — the mesh-resident plane lost its reason to exist")
+    _emit("spmd_serving_count_qps", on_qps, http_qps, {
+        "platform": "cpu-mesh(2proc x 2dev, gloo)",
+        "spmd_mode": "on-vs-http",
+        "distinct_counts": rows, "n_queries": n_meas,
+        "http_fanout_qps": round(http_qps, 2),
+        "speedup": round(speedup, 2),
+        "http_data_plane_bytes_delta": byte_deltas,
+        "batched_queries": d_batched,
+        "collective_steps": d_steps,
+        "queries_per_step": round(n_meas / d_steps, 1)
+        if d_steps else None,
+        "api_nop_ms": round(query_ms, 3),
+        "disabled_hook_ns": round(per_q_ns, 1),
+        "disabled_overhead_pct": round(overhead_pct, 4)})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -2469,6 +2593,7 @@ CONFIGS = {
     "overload": bench_overload,
     "fusion": bench_fusion,
     "incident_overhead": bench_incident_overhead,
+    "spmd_serving": bench_spmd_serving,
 }
 
 

@@ -100,7 +100,8 @@ def cmd_server(args):
         SpmdDataPlane.initialize(
             coordinator_address=f"{coord_host}:{coord_port}",
             num_processes=len(norm),
-            process_id=norm.index(local_ref))
+            process_id=norm.index(local_ref),
+            cpu_collectives=config.get("spmd-cpu-collectives"))
 
     # Durability: fault points arm from the env BEFORE any fsync/replay
     # code runs (a crash harness must be able to hit boot-time points),
@@ -240,7 +241,9 @@ def cmd_server(args):
         from .utils.logger import StandardLogger
 
         spmd = SpmdDataPlane(holder, cluster, _SpmdClient,
-                             logger=StandardLogger())
+                             logger=StandardLogger(),
+                             serve_mode=str(
+                                 config.get("spmd-serve", "off")).lower())
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
               max_writes_per_request=int(mwpr),
@@ -867,7 +870,8 @@ def _apply_server_flags(config, args):
     what `server` runs with (reference: cmd/root.go setAllConfig does this
     once via viper for every subcommand)."""
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
-                 "replicas", "spmd_port", "long_query_time",
+                 "replicas", "spmd_port", "spmd_serve",
+                 "spmd_cpu_collectives", "long_query_time",
                  "max_writes_per_request", "tracing", "workers",
                  "flight_recorder_size", "watchdog_deadline",
                  "incident_dir", "incident_max", "metrics_exemplars",
@@ -1015,6 +1019,21 @@ def main(argv=None):
                    help="TCP port of the JAX distributed coordinator "
                         "service on the FIRST --cluster-hosts node "
                         "(default 27121)")
+    p.add_argument("--spmd-serve", default=None,
+                   choices=("off", "on", "shadow"),
+                   help="mesh-resident SPMD serving: off (default) keeps "
+                        "the legacy per-query collective side-channel "
+                        "byte-identical; on promotes the mesh to the "
+                        "primary data plane (cached sharded stacks, "
+                        "step-stream announcements, batched + fused "
+                        "collective steps); shadow serves legacy while "
+                        "probing the mesh cache for divergence")
+    p.add_argument("--spmd-cpu-collectives", default=None,
+                   choices=("none", "gloo"),
+                   help="CPU-backend collective implementation for "
+                        "--spmd (gloo enables real cross-process CPU "
+                        "collectives, e.g. the 2-process test harness; "
+                        "default none)")
     p.add_argument("--bind", default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config", default=None)
@@ -1254,6 +1273,10 @@ def main(argv=None):
     p.add_argument("--replicas", type=int, default=None)
     p.add_argument("--spmd", action="store_true", default=False)
     p.add_argument("--spmd-port", type=int, default=None)
+    p.add_argument("--spmd-serve", default=None,
+                   choices=("off", "on", "shadow"))
+    p.add_argument("--spmd-cpu-collectives", default=None,
+                   choices=("none", "gloo"))
     p.add_argument("--long-query-time", default=None)
     p.add_argument("--max-writes-per-request", type=int, default=None)
     p.add_argument("--tracing", default=None, choices=["none", "memory"])

@@ -176,6 +176,12 @@ class ClusterExecutor:
     Wraps exec.Executor. With a single-node cluster (or none) it degrades
     to purely local execution."""
 
+    #: what the query coalescer may batch THROUGH a cluster coordinator:
+    #: only Count merges as one collective step (cluster/spmd.py
+    #: SpmdBatchRunner); the local Executor's wider set applies on
+    #: single nodes and fan-out legs
+    BATCHABLE_CALLS = frozenset(("Count",))
+
     def __init__(self, holder, cluster, client_factory, spmd=None,
                  logger=None, max_writes_per_request=0):
         from ..utils.logger import NopLogger
@@ -233,6 +239,18 @@ class ClusterExecutor:
         t_query = _time.perf_counter()
         try:
             plan_calls = [] if explain == "analyze" else None
+            # Fused collective fast path (mesh serving + fusion on): the
+            # WHOLE multi-call Count query runs as one jitted collective
+            # program per process — one announcement, one psum, zero
+            # result bytes over HTTP. Declines (cold fingerprint,
+            # uncoverable tree, degraded mesh) fall through to the
+            # per-call loop unchanged.
+            if self.spmd is not None and plan_calls is None \
+                    and all(not c.writes() for c in query.calls):
+                used, counts = self.spmd.maybe_execute_fused(
+                    idx, query, shards)
+                if used:
+                    return translate_results(idx, query.calls, counts)
             results = []
             deadline = getattr(opt, "deadline", None)
             for call in query.calls:
@@ -280,10 +298,22 @@ class ClusterExecutor:
         node.annotations["nodes"] = len(children)
         node.annotations["shards"] = len(shards or [])
         if self.spmd is not None and not call.writes():
-            # the SPMD collective plane is bypassed under explain so the
-            # per-node sub-plans can be captured; record that the normal
-            # path may differ
-            node.annotations["spmd_bypassed"] = True
+            mesh_child = any(
+                isinstance(c, dict) and c.get("node") == "mesh"
+                for c in children)
+            if mesh_child:
+                # the call executed (or would execute) over the
+                # collective plane — surface the mesh identity at the
+                # call node too, so plan consumers don't have to walk
+                # children to see the serving path
+                node.strategy = "spmd-collective"
+                node.annotations["spmd"] = True
+                node.annotations["mesh"] = self.spmd.mesh_shape()
+            else:
+                # the SPMD collective plane is bypassed under explain so
+                # the per-node sub-plans can be captured; record that
+                # the normal path may differ
+                node.annotations["spmd_bypassed"] = True
         node.children = list(children)
         return node
 
@@ -323,6 +353,17 @@ class ClusterExecutor:
             if call.writes():
                 plan_calls.append(
                     local_planner.plan_call(idx, call, shards, opt))
+                continue
+            if self.spmd is not None \
+                    and self.spmd.plan_eligible(idx, call):
+                # the serving path is the collective plane: ONE mesh
+                # child with zero dispatches (a globally-sharded program
+                # replaces the fan-out), annotated spmd:true + mesh shape
+                plan_calls.append(self._cluster_plan_node(
+                    idx, call, shards,
+                    [{"node": "mesh",
+                      "shards": len(shards or []),
+                      "plan": self.spmd.plan_node(idx, call, shards)}]))
                 continue
             by_node = self.cluster.shards_by_node(idx.name, shards or [])
             children = []
@@ -433,6 +474,17 @@ class ClusterExecutor:
         if self.spmd is not None and plan_sink is None:
             used, result = self.spmd.maybe_execute(idx, call, shards)
             if used:
+                return result
+        elif self.spmd is not None:
+            # ?explain=analyze with the mesh serving: analyze reports
+            # the path that actually serves (PR-16 fused-analyze
+            # contract), so execute over the collective plane and graft
+            # the step's single dispatch + psum bytes onto the plan. A
+            # decline falls through to the per-node analyze fan-out.
+            used, result, entry = self.spmd.maybe_execute_analyze(
+                idx, call, shards)
+            if used:
+                plan_sink.append(entry)
                 return result
         by_node = self.cluster.shards_by_node(idx.name, shards)
 

@@ -88,14 +88,24 @@ class SpmdDataPlane:
     _initialized = False
 
     @classmethod
-    def initialize(cls, coordinator_address, num_processes, process_id):
+    def initialize(cls, coordinator_address, num_processes, process_id,
+                   cpu_collectives=None):
         """Join the global JAX distributed system. MUST run before any JAX
         backend initializes in this process (same constraint as platform
-        selection; see cli._honor_jax_platforms_env)."""
+        selection; see cli._honor_jax_platforms_env).
+
+        cpu_collectives="gloo" opts the CPU backend into real
+        cross-process collectives (the 2-process CPU harness and any
+        gloo-capable CPU cluster); without it multi-process CPU programs
+        raise "Multiprocess computations aren't implemented on the CPU
+        backend". Must be set before the backend initializes, same as the
+        distributed init itself."""
         if cls._initialized:
             return
         import jax
 
+        if cpu_collectives == "gloo":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -110,8 +120,18 @@ class SpmdDataPlane:
     #: compiled-program cache bound (mirrors exec.stacked.MAX_FNS: tiny
     #: functions, but unbounded distinct shapes would accumulate)
     MAX_FNS = 128
+    #: serve-mode values settable at runtime (POST /debug/spmd). "http"
+    #: is runtime-only: it forces maybe_execute to decline so the SAME
+    #: cluster can run the HTTP fan-out path for an A/B bench comparison.
+    SERVE_MODES = ("off", "on", "shadow", "http")
+    #: seconds a peer's stream runner waits on a sequence gap before
+    #: resyncing to the lowest queued step (a lost announcement must not
+    #: wedge the stream forever; the coordinator's collective for the
+    #: lost step fails via the distributed-runtime timeout and falls back)
+    STREAM_GAP_TIMEOUT = 30
 
-    def __init__(self, holder, cluster, client_factory, logger=None):
+    def __init__(self, holder, cluster, client_factory, logger=None,
+                 serve_mode="off"):
         self.holder = holder
         self.cluster = cluster
         self.client_factory = client_factory
@@ -120,6 +140,42 @@ class SpmdDataPlane:
         self._mesh = None
         self._fns = OrderedDict()
         self._step_id = 0
+        # --spmd-serve: "off" keeps the pre-mesh data plane byte-identical
+        # (no cache, blocking step announcements); "on" enables the
+        # mesh-resident cache + step-stream + batched/fused steps;
+        # "shadow" serves legacy while probing the cache for divergence.
+        self.serve_mode = serve_mode if serve_mode in self.SERVE_MODES \
+            else "off"
+        from .meshstacks import MeshStackCache
+
+        self.mesh_cache = MeshStackCache(logger=self.logger)
+        # step-stream control plane (serve_mode == "on"): peers execute
+        # announced steps in sequence order from a runner thread instead
+        # of the announcing HTTP handler thread, so the coordinator can
+        # pipeline announcement N+1 while step N executes.
+        self._stream_cond = threading.Condition()
+        self._stream_queue = {}  # seq -> step
+        self._stream_next = None  # next seq to execute (set by first recv)
+        self._stream_thread = None
+        self._stream_closed = False
+        # outbound stream sequence: SEPARATE from _step_id so legacy-mode
+        # steps (serve off/shadow) never open gaps in the stream — a gap
+        # costs the peer a STREAM_GAP_TIMEOUT resync stall
+        self._stream_seq_out = 0
+        self.stream_errors = 0
+        self.stream_resyncs = 0
+        # per-node step lifecycle counters (satellite: wedge root-cause —
+        # announced>entered means a peer never reached the collective,
+        # entered>exited means the collective itself hung)
+        self.steps_announced = 0
+        self.steps_entered = 0
+        self.steps_exited = 0
+        self.last_seq = 0
+        # batched/fused collective accounting
+        self.batch_steps = 0
+        self.batched_queries = 0
+        self.fused_steps = 0
+        self.fused_queries = 0
         # Count pre-flight epochs: {index: membership epoch} of the last
         # successful validation round. Steps carry resolved plans, so the
         # per-query peer checks are all membership/boot-constant — one
@@ -147,11 +203,9 @@ class SpmdDataPlane:
         each process's addressable block is contiguous along the shard
         axis (what make_array_from_process_local_data fills)."""
         if self._mesh is None:
-            import jax
+            from ..parallel.sharded import build_global_mesh
 
-            devices = sorted(jax.devices(),
-                             key=lambda d: (d.process_index, d.id))
-            self._mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
+            self._mesh = build_global_mesh()
         import jax
 
         spec = [None] * ndim
@@ -168,6 +222,19 @@ class SpmdDataPlane:
         import jax
 
         return jax.process_count()
+
+    def mesh_shape(self):
+        """(processes, devices per process) — the mesh-key component and
+        the shape EXPLAIN reports."""
+        return [self._num_processes(), self._local_device_count()]
+
+    def set_serve_mode(self, mode):
+        """Runtime serve-mode switch (POST /debug/spmd). Raises on an
+        unknown mode; the caller maps that to a 400."""
+        if mode not in self.SERVE_MODES:
+            raise SpmdError(f"unknown spmd serve mode: {mode!r}")
+        self.serve_mode = mode
+        return self.serve_mode
 
     # -- signature helper ----------------------------------------------------
 
@@ -262,6 +329,8 @@ class SpmdDataPlane:
         coordinator initiates directly; other nodes forward eligible calls
         to the coordinator in one hop (reference: any node coordinates,
         executor.go:113)."""
+        if self.serve_mode == "http":
+            return False, None  # bench A/B: force the HTTP fan-out path
         kind = self._call_kind(call)
         if kind is None:
             return False, None
@@ -440,40 +509,101 @@ class SpmdDataPlane:
         }
 
     def _execute_step(self, step):
-        """Announce + run one validated step (coordinator side)."""
+        """Announce + run one validated step (coordinator side).
+
+        Legacy (serve != on): blocking POST /internal/spmd/step per peer,
+        joined around the local collective — byte-identical to the
+        pre-mesh control plane.
+
+        Streamed (serve == on): fire-and-ack POST /internal/spmd/stream —
+        the peer enqueues the step by sequence number and acks before
+        executing, so this call returns as soon as the LOCAL collective
+        completes and the coordinator can announce step N+1 while a slow
+        peer is still inside step N (the collective itself is the
+        synchronization; the old blocking join double-paid it in HTTP
+        round-trip time)."""
+        from ..utils import flightrec
+
+        streamed = self.serve_mode == "on"
         with self._lock:
             self._step_id += 1
             step["step"] = self._step_id
+            if streamed:
+                self._stream_seq_out += 1
+                step["seq"] = self._stream_seq_out
+            self.steps_announced += 1
+            flightrec.record(
+                "spmd.step_announce", index=step.get("index", ""),
+                op=step.get("kind", "count"),
+                seq=step.get("seq", self._step_id), streamed=streamed)
             errors = []
 
             def post(node):
                 try:
                     client = self.client_factory(node.uri)
                     client.timeout = self.STEP_TIMEOUT
-                    client.spmd_step(step)
+                    if streamed:
+                        client.spmd_stream(step)
+                    else:
+                        client.spmd_step(step)
                 except Exception as e:  # surfaced after the collective
                     errors.append((node.id, e))
 
-            threads = [threading.Thread(target=post, args=(n,))
+            threads = [threading.Thread(target=post, args=(n,),
+                                        daemon=True)
                        for n in self.cluster.peers()]
             for t in threads:
                 t.start()
-            # join the collective ourselves — peers are inside run_step now
-            result = self._run_step_locked(step)
+            # join the collective ourselves — peers are inside run_step
+            # (legacy) or their stream runner (streamed) now
+            result = self._enter_exit_run(step)
+            if not streamed:
+                for t in threads:
+                    t.join()
+        if streamed:
+            # acks raced the collective; collect without holding the lock
             for t in threads:
-                t.join()
+                t.join(timeout=self.VALIDATE_TIMEOUT)
         if errors:
             # We hold a replicated result: for validated-this-query steps
             # every process joined the collective and these are
-            # post-collective transport errors (lost responses). For
-            # epoch-skipped count steps a dead peer instead fails the
-            # collective itself, which raises out of _run_step_locked and
-            # is handled by the maybe_execute watchdog (epoch invalidated,
-            # HTTP fallback). Log, don't fail the query.
+            # post-collective transport errors (lost responses / lost
+            # stream acks). For epoch-skipped count steps a dead peer
+            # instead fails the collective itself, which raises out of
+            # _run_step_locked and is handled by the maybe_execute
+            # watchdog (epoch invalidated, HTTP fallback). Log, don't
+            # fail the query.
+            if streamed:
+                self.stream_errors += len(errors)
             self.logger.printf(
                 "spmd: post-collective peer errors (result kept): %s",
                 errors)
         return result
+
+    def _enter_exit_run(self, step):
+        """_run_step_locked bracketed by the step-lifecycle events the
+        wedge classifier reads (bench._classify_wedge): a node whose
+        flightrec shows announce-without-enter never reached the
+        collective (control-plane loss); enter-without-exit means the
+        collective itself hung. Caller holds self._lock."""
+        from ..utils import flightrec
+
+        seq = int(step.get("seq") or step.get("step") or 0)
+        self.steps_entered += 1
+        self.last_seq = max(self.last_seq, seq)
+        flightrec.record("spmd.step_enter", index=step.get("index", ""),
+                         op=step.get("kind", "count"), seq=seq)
+        ok = False
+        try:
+            result = self._run_step_locked(step)
+            ok = True
+            return result
+        finally:
+            self.steps_exited += 1
+            flightrec.record("spmd.step_exit",
+                             index=step.get("index", ""),
+                             op=step.get("kind", "count"), seq=seq,
+                             ok=ok)
 
     def _try_count(self, idx, call, shards):
         """Count(call) merged over the global mesh, or None to fall back
@@ -494,6 +624,239 @@ class SpmdDataPlane:
         if not self._ensure_count_epoch(step):
             return None
         return self._execute_step(step)
+
+    # -- batched collective steps (PR-9 coalescer x mesh) --------------------
+
+    def _cluster_ready(self, forwarded=False):
+        """The maybe_execute cluster gates, shared by the batch and fused
+        entries: coordinator-only (they are called from the coalescer /
+        executor on the serving node), every node READY, membership
+        unchanged since distributed init."""
+        cluster = self.cluster
+        if cluster is None or len(cluster.nodes) < 2:
+            return False
+        from .node import NODE_STATE_READY
+
+        if any(n.state != NODE_STATE_READY for n in cluster.nodes):
+            return False
+        if tuple(sorted(n.id for n in cluster.nodes)) \
+                != self._boot_node_ids:
+            return False
+        coord = cluster.coordinator
+        return coord is not None and coord.id == cluster.local_id
+
+    def _count_plans(self, idx, calls):
+        """Wire plans for a list of Count calls, or None when any call
+        isn't coverable (the whole batch falls back — splitting would
+        break the one-announcement contract)."""
+        plans = []
+        for call in calls:
+            if self._call_kind(call) != "count":
+                return None
+            sig_leaves = self._signature(idx, call.children[0])
+            if sig_leaves is None:
+                return None
+            sig, leaf_keys = sig_leaves
+            plans.append({"sig": sig_to_wire(sig),
+                          "leaves": [self._leaf_to_wire(k)
+                                     for k in leaf_keys]})
+        return plans
+
+    def maybe_execute_batch(self, idx, calls, shards):
+        """K eligible Count calls as ONE collective step: (used, counts).
+        The PR-9 coalescer's cluster adapter (SpmdBatchRunner) lands
+        here; serve_mode must be on — batching changes the control-plane
+        shape, so it never runs on the byte-identical legacy path."""
+        if self.serve_mode != "on" or not calls:
+            return False, None
+        if not self._cluster_ready():
+            return False, None
+        plans = self._count_plans(idx, calls)
+        if plans is None:
+            return False, None
+        from ..exec.stacked import batch_bucket
+
+        step = self._gate(idx, shards)
+        step["kind"] = "count_batch"
+        k = len(plans)
+        bucket = batch_bucket(k)
+        # pad to the bucket by repeating plan 0 — the mesh cache serves
+        # the repeats from device memory and the vmapped group evaluates
+        # them in the same walk, so padding is near-free (PR-9 contract)
+        step["plans"] = plans + [plans[0]] * (bucket - k)
+        step["bucket"] = bucket
+        if not self._ensure_count_epoch(step):
+            return False, None
+        from ..utils import tracing
+
+        try:
+            with tracing.start_span("spmd.step", kind="count_batch",
+                                    shards=len(shards), batch=k):
+                counts = self._execute_step(step)
+        except Exception as e:
+            self.fallbacks += 1
+            self._count_epochs.pop(idx.name, None)
+            self.logger.printf(
+                "spmd: count_batch step failed (%s); epoch invalidated, "
+                "falling back to per-query path", e)
+            return False, None
+        self.batched_queries += k
+        return True, counts[:k]
+
+    # -- fused collective programs (PR-16 fusion x mesh) ---------------------
+
+    def maybe_execute_fused(self, idx, query, shards):
+        """Whole multi-call cluster query as ONE fused collective program:
+        (used, counts). Gated by the PR-16 fusion admission rules (a cold
+        fingerprint never pays a collective compile) and ledgered under
+        the mesh-shaped program key, so /debug/fusion shows which fabric
+        each collective program was traced for. Warm path: one jitted
+        program per process, one announcement, zero result bytes over
+        HTTP."""
+        from ..exec import fusion as fusion_mod
+
+        if self.serve_mode != "on" or not fusion_mod.acting():
+            return False, None
+        calls = list(query.calls)
+        if not calls or any(self._call_kind(c) != "count" for c in calls):
+            return False, None
+        if not self._cluster_ready():
+            return False, None
+        from ..utils import workload as workload_mod
+
+        fp = workload_mod.current_fingerprint()
+        if fp is None:
+            fp, _ = workload_mod.fingerprint(idx.name, query)
+        if not fusion_mod.admit(fp):
+            return False, None
+        plans = self._count_plans(idx, calls)
+        if plans is None:
+            return False, None
+        from ..exec.stacked import batch_bucket
+
+        step = self._gate(idx, shards)
+        step["kind"] = "count_batch"
+        k = len(plans)
+        bucket = batch_bucket(k)
+        step["plans"] = plans + [plans[0]] * (bucket - k)
+        step["bucket"] = bucket
+        if not self._ensure_count_epoch(step):
+            return False, None
+        sigs = tuple(sig_from_wire(p["sig"]) for p in step["plans"])
+        arities = tuple(len(p["leaves"]) for p in step["plans"])
+        fn_key = ("count_batch", sigs, arities)
+        compiled = fn_key not in self._fns
+        import time as _time
+
+        from ..utils import tracing
+
+        t0 = _time.perf_counter()
+        try:
+            with tracing.start_span("spmd.step", kind="fused",
+                                    shards=len(shards), batch=k):
+                counts = self._execute_step(step)
+        except Exception as e:
+            self.fallbacks += 1
+            self._count_epochs.pop(idx.name, None)
+            self.logger.printf(
+                "spmd: fused step failed (%s); epoch invalidated, "
+                "falling back to per-call path", e)
+            return False, None
+        wall = _time.perf_counter() - t0
+        # ledger AFTER _execute_step released self._lock: fusion eviction
+        # re-enters ev._lock (ours) to drop the jitted collective
+        key = fusion_mod.mesh_program_key(fp, sigs, bucket,
+                                          self.mesh_shape())
+        fusion_mod.touch_mesh_program(
+            key, self, fn_key,
+            compile_ms=wall * 1000 if compiled else None)
+        fusion_mod.note_fused(k)
+        workload_mod.note_batch(k)
+        self.fused_steps += 1
+        self.fused_queries += 1
+        return True, counts[:k]
+
+    # -- EXPLAIN (plan + analyze) --------------------------------------------
+
+    def plan_eligible(self, idx, call):
+        """Would the normal serving path take the collective plane for
+        this call? The ?explain=true annotation gate — nothing executes."""
+        if self.serve_mode != "on":
+            return False
+        kind = self._call_kind(call)
+        if kind is None:
+            return False
+        cluster = self.cluster
+        if cluster is None or len(cluster.nodes) < 2:
+            return False
+        from .node import NODE_STATE_READY
+
+        if any(n.state != NODE_STATE_READY for n in cluster.nodes):
+            return False
+        if tuple(sorted(n.id for n in cluster.nodes)) \
+                != self._boot_node_ids:
+            return False
+        if cluster.coordinator is None:
+            return False
+        return self._eligible(idx, call, kind)
+
+    def plan_node(self, idx, call, shards):
+        """Serialized mesh plan entry for ?explain=true: the collective
+        path runs ZERO per-node dispatches from the coordinator's view —
+        one globally-sharded program replaces the fan-out."""
+        return {
+            "op": call.name,
+            "strategy": "spmd-collective",
+            "annotations": {
+                "spmd": True,
+                "mesh": self.mesh_shape(),
+                "dispatches": 0,
+                "shards": len(shards or []),
+            },
+            "children": [],
+        }
+
+    @staticmethod
+    def _psum_bytes(kind, result):
+        """Replicated all-reduce output payload per process — the bytes
+        the collective moved in place of an HTTP result body. Count is
+        the (hi, lo) int32 pair; vector kinds scale by output length."""
+        if isinstance(result, (list, tuple)):
+            return 8 * max(1, len(result))
+        return 8
+
+    def maybe_execute_analyze(self, idx, call, shards):
+        """?explain=analyze through the collective plane: really execute
+        (PR-16 fused-analyze contract: analyze reports the path that
+        serves), then graft the step's single dispatch + psum bytes onto
+        a mesh plan entry. (used, result, plan_entry)."""
+        if self.serve_mode != "on":
+            return False, None, None
+        import time as _time
+
+        t0 = _time.perf_counter()
+        used, result = self.maybe_execute(idx, call, shards)
+        if not used:
+            return False, None, None
+        wall = _time.perf_counter() - t0
+        kind = self._call_kind(call)
+        entry = {
+            "node": "mesh",
+            "shards": len(shards or []),
+            "plan": {
+                "op": call.name,
+                "strategy": "spmd-collective",
+                "annotations": {
+                    "spmd": True,
+                    "mesh": self.mesh_shape(),
+                    "dispatches": 1,
+                    "psum_bytes": self._psum_bytes(kind, result),
+                    "wall_ms": round(wall * 1000, 3),
+                },
+                "children": [],
+            },
+        }
+        return True, result, entry
 
     def _membership_epoch(self):
         return tuple((n.id, n.state) for n in self.cluster.nodes)
@@ -856,9 +1219,90 @@ class SpmdDataPlane:
     # -- step execution (every process) --------------------------------------
 
     def run_step(self, step):
-        """HTTP-handler entry for peer processes."""
+        """HTTP-handler entry for peer processes (blocking legacy
+        announcements, serve_mode != on)."""
         with self._lock:
-            return self._run_step_locked(step)
+            return self._enter_exit_run(step)
+
+    def run_stream(self, step):
+        """HTTP-handler entry for STREAMED announcements (serve == on):
+        enqueue by sequence number and ack immediately — the stream
+        runner thread executes steps in seq order, so the coordinator's
+        announcing thread never blocks on this peer's collective."""
+        seq = int(step["seq"])
+        with self._stream_cond:
+            self._stream_queue[seq] = step
+            if self._stream_next is None:
+                self._stream_next = seq
+            if self._stream_thread is None \
+                    or not self._stream_thread.is_alive():
+                self._stream_thread = threading.Thread(
+                    target=self._stream_loop, name="spmd-stream",
+                    daemon=True)
+                self._stream_thread.start()
+            self._stream_cond.notify_all()
+        return {"ok": True, "seq": seq, "queued": len(self._stream_queue)}
+
+    def close(self):
+        """Stop the stream runner (server shutdown)."""
+        with self._stream_cond:
+            self._stream_closed = True
+            self._stream_cond.notify_all()
+
+    def _stream_loop(self):
+        """Peer-side stream runner: executes queued steps strictly in
+        sequence order. A gap (announcement lost while later steps keep
+        arriving) times out after STREAM_GAP_TIMEOUT and resyncs to the
+        lowest queued seq — the coordinator's collective for the lost
+        step already failed via the distributed-runtime timeout and fell
+        back to HTTP, so skipping it here preserves the identical
+        program order on every process for the steps that DID run."""
+        from ..utils import flightrec
+
+        while True:
+            with self._stream_cond:
+                deadline = None
+                while not self._stream_closed:
+                    nxt = self._stream_next
+                    if nxt is not None and nxt in self._stream_queue:
+                        break
+                    if self._stream_queue:
+                        import time as _time
+
+                        now = _time.monotonic()
+                        if deadline is None:
+                            deadline = now + self.STREAM_GAP_TIMEOUT
+                        if now >= deadline:
+                            resync = min(self._stream_queue)
+                            self.stream_resyncs += 1
+                            flightrec.record(
+                                "spmd.stream_resync",
+                                expected=nxt, resync=resync)
+                            self.logger.printf(
+                                "spmd: stream gap at seq %s; resyncing "
+                                "to %s", nxt, resync)
+                            self._stream_next = resync
+                            break
+                        self._stream_cond.wait(deadline - now)
+                    else:
+                        deadline = None
+                        self._stream_cond.wait(1.0)
+                if self._stream_closed:
+                    return
+                step = self._stream_queue.pop(self._stream_next)
+                self._stream_next += 1
+            try:
+                with self._lock:
+                    # result discarded: the collective output is
+                    # replicated, only the coordinator reads it
+                    self._enter_exit_run(step)
+            except Exception as e:
+                # the coordinator saw the same collective failure and
+                # fell back; keep this runner alive for the next step
+                self.stream_errors += 1
+                self.logger.printf(
+                    "spmd: streamed step %s failed on this node: %s",
+                    step.get("seq"), e)
 
     def _run_step_locked(self, step):
         # A validated peer MUST enter the collective: every failure mode
@@ -871,6 +1315,8 @@ class SpmdDataPlane:
         kind = step.get("kind", "count")
         if kind == "count":
             return self._run_count_step(idx, step)
+        if kind == "count_batch":
+            return self._run_count_batch_step(idx, step)
         if kind == "sum":
             return self._run_sum_step(idx, step)
         if kind == "minmax":
@@ -953,37 +1399,73 @@ class SpmdDataPlane:
             self._local_exec = Executor(self.holder)
         return self._local_exec
 
-    def _leaf_arrays(self, idx, step):
-        """Globally-sharded [S, W] arrays for a step's plan leaves
-        (tagged wire entries: ["row", f, r] | ["bsicond", f, op, vals])."""
+    def _local_leaf_block(self, idx, step, entry):
+        """This process's [seg_len, W] host block for one wire leaf
+        (defensive: zeros for anything missing locally)."""
+        if entry[0] == "bsicond":
+            _, field_name, op, vals = entry
+            return self._local_cond_block(idx, step, field_name, op, vals)
+        if entry[0] == "timerow":
+            # union across the quantum-view cover, host-side (each
+            # view's block is defensive zeros when absent locally)
+            _, field_name, row_id, views = entry
+            local = np.zeros((int(step["seg_len"]), WORDS_PER_ROW),
+                             dtype=np.uint32)
+            for view_name in views:
+                local |= self._local_block(
+                    idx, step, field_name, int(row_id),
+                    view_name=view_name)
+            return local
+        _, field_name, row_id = entry
+        return self._local_block(idx, step, field_name, int(row_id))
+
+    def _leaf_array(self, idx, step, entry, sharding, global_shape):
+        """ONE globally-sharded leaf array, mesh-cache aware.
+
+        serve == on: probe the mesh-resident cache first — a hit returns
+        the device-placed global-array handle without touching host
+        fragments or re-uploading (the tentpole win). Per-process cache
+        divergence is safe: this handle only feeds this process's
+        addressable shards (meshstacks module doc).
+        serve == shadow: legacy gather serves; the fresh block feeds the
+        cache's divergence detector.
+        serve == off/http: byte-identical legacy path, cache untouched.
+        """
         import jax
 
+        from .meshstacks import entry_key
+
+        seg_len = int(step["seg_len"])
+        my_shards = tuple(step["segments"].get(self.cluster.local_id, []))
+        key = (step["index"], entry_key(entry), seg_len, my_shards)
+        gens = None
+        if self.serve_mode in ("on", "shadow"):
+            gens = self.mesh_cache.gens(idx, entry, my_shards)
+        if self.serve_mode == "on" and gens is not None:
+            arr = self.mesh_cache.get(key, gens)
+            if arr is not None:
+                return arr
+        local = self._local_leaf_block(idx, step, entry)
+        arr = jax.make_array_from_process_local_data(
+            sharding, local, global_shape=global_shape)
+        if gens is not None:
+            if self.serve_mode == "on":
+                self.mesh_cache.put(key, gens, arr, local)
+            else:
+                self.mesh_cache.shadow_probe(key, gens, local)
+        return arr
+
+    def _leaf_arrays(self, idx, step):
+        """Globally-sharded [S, W] arrays for a step's plan leaves
+        (tagged wire entries: ["row", f, r] | ["bsicond", f, op, vals] |
+        ["timerow", f, r, views])."""
         n_proc = self._num_processes()
         seg_len = int(step["seg_len"])
         sharding = self._global_sharding()
         global_shape = (n_proc * seg_len, WORDS_PER_ROW)
-        arrays = []
-        for entry in step.get("leaves", []):
-            if entry[0] == "bsicond":
-                _, field_name, op, vals = entry
-                local = self._local_cond_block(
-                    idx, step, field_name, op, vals)
-            elif entry[0] == "timerow":
-                # union across the quantum-view cover, host-side (each
-                # view's block is defensive zeros when absent locally)
-                _, field_name, row_id, views = entry
-                local = np.zeros((int(step["seg_len"]), WORDS_PER_ROW),
-                                 dtype=np.uint32)
-                for view_name in views:
-                    local |= self._local_block(
-                        idx, step, field_name, int(row_id),
-                        view_name=view_name)
-            else:
-                _, field_name, row_id = entry
-                local = self._local_block(idx, step, field_name,
-                                          int(row_id))
-            arrays.append(jax.make_array_from_process_local_data(
-                sharding, local, global_shape=global_shape))
+        arrays = [self._leaf_array(idx, step, entry, sharding,
+                                   global_shape)
+                  for entry in step.get("leaves", [])]
         return arrays, global_shape
 
     def _run_count_step(self, idx, step):
@@ -995,6 +1477,32 @@ class SpmdDataPlane:
         from ..ops.bitplane import combine_hi_lo
 
         return int(combine_hi_lo(hi, lo))
+
+    def _run_count_batch_step(self, idx, step):
+        """K Count plans in ONE collective step: gather every plan's
+        leaf arrays (the mesh cache dedups the bucket-padding repeats and
+        shared leaves across plans), evaluate all trees in one jitted
+        program — same-signature plans vmapped over a stacked leaf axis —
+        and all-reduce all K per-shard popcounts together. One
+        announcement, one program, one psum for the whole batch."""
+        from ..ops.bitplane import combine_hi_lo
+
+        sigs = []
+        arities = []
+        all_arrays = []
+        for plan in step["plans"]:
+            sigs.append(sig_from_wire(plan["sig"]))
+            sub = dict(step)
+            sub["leaves"] = plan["leaves"]
+            arrays, _ = self._leaf_arrays(idx, sub)
+            arities.append(len(arrays))
+            all_arrays.extend(arrays)
+        fn = self._count_batch_fn(tuple(sigs), tuple(arities))
+        hilo = np.asarray(fn(*all_arrays))  # [2, K]: one host transfer
+        self.steps_run += 1
+        self.batch_steps += 1
+        return [int(combine_hi_lo(int(h), int(l)))
+                for h, l in zip(hilo[0], hilo[1])]
 
     def _bsi_arrays(self, idx, step):
         """Globally-sharded (planes [D,S,W], sign [S,W], exists [S,W]) for
@@ -1172,6 +1680,72 @@ class SpmdDataPlane:
 
         return self._get_fn(("count", sig, arity), build)
 
+    def _count_batch_fn(self, sigs, arities):
+        """K Count trees in one program. Runs of IDENTICAL (sig, arity)
+        — the common case after bucket padding repeats plans[0] — are
+        stacked on a new leading axis and evaluated with ONE vmapped
+        tree walk (PR-9's batching shape, lifted to the collective
+        plane); distinct signatures evaluate inline in the same trace.
+        Either way XLA sees a single program and inserts ONE
+        cross-process reduce for all K outputs. Returns a single
+        stacked [2, K] array — row 0 the hi halves, row 1 the lo
+        halves, in plan order — so the warm path costs one reduce pair
+        and one host fetch total."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..exec.stacked import tree_eval
+        from ..ops.bitplane import hi_lo
+
+        def build():
+            # group plan positions by identical (sig, arity) runs
+            groups = OrderedDict()
+            for pos, sa in enumerate(zip(sigs, arities)):
+                groups.setdefault(sa, []).append(pos)
+            offsets = []
+            off = 0
+            for a in arities:
+                offsets.append(off)
+                off += a
+
+            @jax.jit
+            def fn(*stacks):
+                def count(sig, leaf_stacks):
+                    acc = tree_eval(sig, leaf_stacks)
+                    return jnp.sum(
+                        jax.lax.population_count(acc).astype(jnp.int32),
+                        axis=-1)
+
+                per_plan = [None] * len(sigs)
+                for (sig, arity), positions in groups.items():
+                    if len(positions) > 1 and arity > 0:
+                        # [G, S, W] per leaf slot -> one vmapped walk
+                        batched = [
+                            jnp.stack([stacks[offsets[p] + i]
+                                       for p in positions])
+                            for i in range(arity)]
+                        per_shard = jax.vmap(
+                            lambda *ls, _sig=sig: count(_sig, ls))(
+                                *batched)
+                        for g, p in enumerate(positions):
+                            per_plan[p] = per_shard[g]
+                    else:
+                        for p in positions:
+                            ls = stacks[offsets[p]:offsets[p] + arity]
+                            per_plan[p] = count(sig, ls)
+                # ONE reduce + ONE fetch for the whole batch: per-plan
+                # hi_lo in a Python loop would emit 2K separate
+                # cross-process all-reduces (each pays a full gloo
+                # sync); stacking the [S] per-shard counts to [K, S]
+                # first makes the hi/lo sums a single pair of
+                # collectives regardless of K, and stacking hi over lo
+                # makes the host transfer a single [2, K] array
+                return jnp.stack(hi_lo(jnp.stack(per_plan), axis=-1))
+
+            return fn
+
+        return self._get_fn(("count_batch", sigs, arities), build)
+
     def _sum_fn(self, sig, arity):
         """(planes [D,S,W], sign, exists, *filter leaves) -> per-plane
         pos/neg popcounts + consider count as (hi, lo) int32 pairs, with
@@ -1312,8 +1886,166 @@ class SpmdDataPlane:
     def stats(self):
         return {"steps": self.steps_run,
                 "initialized": type(self)._initialized,
+                "serve_mode": self.serve_mode,
                 "validations": self.validations,
                 "validations_skipped": self.validations_skipped,
                 "forwarded": self.forwarded,
                 "forward_errors": self.forward_errors,
-                "fallbacks": self.fallbacks}
+                "fallbacks": self.fallbacks,
+                "batch_steps": self.batch_steps,
+                "batched_queries": self.batched_queries,
+                "fused_steps": self.fused_steps,
+                "fused_queries": self.fused_queries}
+
+    def debug_snapshot(self):
+        """GET /debug/spmd: serve mode + mesh shape, the step-lifecycle
+        counters the wedge classifier reads (announced vs entered vs
+        exited per node), stream state, mesh-cache stats, and the HTTP
+        data-plane byte counter (zero while collectives serve)."""
+        from ..server import client as client_mod
+
+        with self._stream_cond:
+            stream = {
+                "next": self._stream_next,
+                "queued": len(self._stream_queue),
+                "errors": self.stream_errors,
+                "resyncs": self.stream_resyncs,
+            }
+        try:
+            mesh = self.mesh_shape()
+        except Exception:  # backend not initialized yet
+            mesh = None
+        return {
+            "serve_mode": self.serve_mode,
+            "initialized": type(self)._initialized,
+            "mesh": mesh,
+            "steps": {
+                "run": self.steps_run,
+                "announced": self.steps_announced,
+                "entered": self.steps_entered,
+                "exited": self.steps_exited,
+                "last_seq": self.last_seq,
+                "batch": self.batch_steps,
+                "fused": self.fused_steps,
+            },
+            "queries": {
+                "batched": self.batched_queries,
+                "fused": self.fused_queries,
+                "forwarded": self.forwarded,
+                "fallbacks": self.fallbacks,
+            },
+            "stream": stream,
+            "mesh_cache": self.mesh_cache.stats(),
+            "http_data_plane_bytes": client_mod.data_plane_bytes(),
+        }
+
+
+class SpmdBatchRunner:
+    """PR-9 coalescer adapter for cluster coordinators (serve == on):
+    presents Executor.launch_batch/resolve_batch's (handle, state) ->
+    [(results, error, batch, fingerprint)] contract, but resolves
+    eligible Count batches as ONE collective step
+    (SpmdDataPlane.maybe_execute_batch) instead of local vmapped
+    dispatches — one announcement, one program, one psum for K queries.
+    Launch is deliberately cheap: the collective IS the fused dispatch
+    (there is no device enqueue to overlap), so the coalescer's
+    double-buffering degenerates to serial resolution without waste.
+    Anything ineligible or declined re-runs on the ordinary cluster
+    path per member (per-query error isolation, PR-9 contract)."""
+
+    #: what server.api._try_coalesce admits on a cluster coordinator —
+    #: only Count merges collectively; other batchable families stay on
+    #: the per-query cluster path
+    BATCHABLE_CALLS = frozenset(("Count",))
+
+    def __init__(self, api):
+        self.api = api
+        self.spmd = api.spmd
+
+    def launch_batch(self, index_name, queries, shards=None,
+                     options=None):
+        return None, (index_name, list(queries))
+
+    def resolve_batch(self, handle, state):
+        import copy
+        import time as _time
+
+        from ..exec.executor import validate_uint_args
+        from ..exec.stacked import BATCH_BUCKETS
+        from ..exec.translate import translate_calls, translate_results
+        from ..utils import workload as workload_mod
+
+        index_name, queries = state
+        executor = self.api.executor
+        idx = executor.holder.index(index_name)
+        entries = []
+        for query in queries:
+            # e["raw"] is the untranslated form every fallback must
+            # re-execute from — translation mutates the call tree in
+            # place and is not idempotent (exec.executor.launch_batch)
+            e = {"query": query, "raw": query, "error": None,
+                 "eligible": False, "out": None}
+            entries.append(e)
+            if idx is None:
+                e["error"] = SpmdError(f"index not found: {index_name}")
+                continue
+            try:
+                if isinstance(query, str):
+                    query = e["query"] = parse(query)
+                calls = query.calls
+                if len(calls) == 1 and calls[0].name == "Count" \
+                        and len(calls[0].children) == 1 \
+                        and not calls[0].writes():
+                    if not isinstance(e["raw"], str):
+                        e["raw"] = copy.deepcopy(query)
+                    translate_calls(idx, query.calls)
+                    validate_uint_args(calls[0])
+                    e["eligible"] = True
+            except Exception as exc:  # noqa: BLE001 — per-query isolation
+                e["error"] = exc
+        eligible = [e for e in entries
+                    if e["eligible"] and e["error"] is None]
+        if eligible:
+            cluster_shards = executor.cluster_shards(idx)
+            cap = BATCH_BUCKETS[-1]
+            for i in range(0, len(eligible), cap):
+                chunk = eligible[i:i + cap]
+                calls = [e["query"].calls[0] for e in chunk]
+                t0 = _time.perf_counter()
+                used, counts = self.spmd.maybe_execute_batch(
+                    idx, calls, cluster_shards)
+                if not used:
+                    continue  # whole chunk re-runs per-query below
+                wall = _time.perf_counter() - t0
+                k = len(chunk)
+                for j, (e, count) in enumerate(zip(chunk, counts)):
+                    try:
+                        wctx = workload_mod.begin_query(
+                            idx.name, e["query"])
+                        wctx.strategies.append("Count=spmd-collective")
+                        workload_mod.note_batch(k)
+                        # charge the step's one dispatch to exactly ONE
+                        # member (exec.executor.resolve_batch rule)
+                        workload_mod.end_query(wctx, wall / k, deltas={
+                            "dispatches": 1 if j == 0 else 0,
+                            "cache_hits": 0, "cache_misses": 0,
+                            "bytes_materialized": 0})
+                        results = translate_results(
+                            idx, e["query"].calls, [int(count)])
+                        e["out"] = (results, None, k, wctx.fingerprint)
+                    except Exception as exc:  # noqa: BLE001
+                        e["out"] = (None, exc, 0, None)
+        outs = []
+        for e in entries:
+            if e["out"] is not None:
+                outs.append(e["out"])
+            elif e["error"] is not None:
+                outs.append((None, e["error"], 0, None))
+            else:
+                try:
+                    results = executor.execute(index_name, e["raw"])
+                    outs.append((results, None, 0,
+                                 workload_mod.last_fingerprint()))
+                except Exception as exc:  # noqa: BLE001
+                    outs.append((None, exc, 0, None))
+        return outs

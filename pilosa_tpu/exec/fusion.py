@@ -190,7 +190,10 @@ def cache_status(fp):
 
 def _touch_program(key, ev, fn_key, compile_ms=None):
     """Record one fused execution against `key`; returns True when the
-    entry already existed (a program-cache hit)."""
+    entry already existed (a program-cache hit). A 4-component key is a
+    MESH program (cluster/spmd.py): its 4th component is the mesh shape
+    (processes, devices per process), recorded on the entry so the
+    ledger shows which fabric a program was traced for."""
     now = time.time()
     with _lock:
         entry = _programs.get(key)
@@ -201,6 +204,8 @@ def _touch_program(key, ev, fn_key, compile_ms=None):
                 "compile_ms": 0.0, "hits": 0, "created": now,
                 "last_hit": now, "evaluator": ev, "fn_key": fn_key,
             }
+            if len(key) > 3:
+                entry["mesh"] = list(key[3])
             _by_fp.setdefault(key[0], set()).add(key)
             _evict_over_budget()
         else:
@@ -209,6 +214,50 @@ def _touch_program(key, ev, fn_key, compile_ms=None):
         entry["last_hit"] = now
         if compile_ms is not None:
             entry["compile_ms"] = round(compile_ms, 3)
+    return hit
+
+
+# ------------------------------------------------- mesh (collective) programs
+
+
+def admit(fp):
+    """Shared compile-admission verdict for a fingerprint: a live
+    program, or enough completed queries to cross the min-hits floor.
+    The SPMD fused path (cluster/spmd.maybe_execute_fused) applies the
+    same cold-shape-never-compiles rule as the local fused path."""
+    from ..utils import workload as workload_mod
+
+    if has_program(fp):
+        return True
+    return workload_mod.fingerprint_hits(fp) >= _min_hits
+
+
+def mesh_program_key(fp, sigs, bucket, mesh):
+    """Ledger key for a fused COLLECTIVE program: the local key's
+    (fingerprint, signatures, shard bucket) extended by the mesh shape —
+    the same fingerprint traced on a different fabric is a different
+    program (the all-reduce is compiled against a specific device set)."""
+    return (fp, tuple(sigs), int(bucket), tuple(int(m) for m in mesh))
+
+
+def touch_mesh_program(key, ev, fn_key, compile_ms=None):
+    """Record one fused collective execution. `ev` duck-types the
+    evaluator contract (_lock + _fns) — SpmdDataPlane qualifies, so
+    eviction drops the jitted collective exactly like a local program.
+    MUST be called after the data plane's step lock is released:
+    eviction takes ev._lock (see _evict_over_budget).
+
+    Returns True on a program-cache hit."""
+    hit = _touch_program(key, ev, fn_key, compile_ms=compile_ms)
+    if compile_ms is not None:
+        _flightrec.record("fusion.compile", fingerprint=key[0],
+                          calls=len(key[1]), bucket=key[2],
+                          mesh=list(key[3]),
+                          compile_ms=round(compile_ms, 3))
+    _bump("fused")
+    global_stats.count("fused_dispatches_total", 1)
+    if hit:
+        global_stats.count("fusion_cache_hits_total", 1)
     return hit
 
 
@@ -349,6 +398,7 @@ def snapshot():
             "hits": e["hits"],
             "age_seconds": round(now - e["created"], 1),
             "last_hit_age_seconds": round(now - e["last_hit"], 1),
+            **({"mesh": e["mesh"]} if "mesh" in e else {}),
         } for e in _programs.values()]
         return {
             "mode": _mode,

@@ -445,6 +445,14 @@ def tree_signature(idx, call, leaves, leaf, bsi_leaf=None, time_leaf=None):
     return None
 
 
+def tree_eval(sig, stacks):
+    """THE traced operator-tree evaluator over aligned leaf stacks —
+    module-level entry so the SPMD collective programs (cluster/spmd.py)
+    share the exact expression semantics of the local serving kernels
+    instead of reaching into StackedEvaluator internals."""
+    return StackedEvaluator._tree_eval(sig, stacks)
+
+
 class StackedEvaluator:
     def __init__(self):
         self._stacks = OrderedDict()  # key -> (gens, device arrays, nbytes)

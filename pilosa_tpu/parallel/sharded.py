@@ -59,6 +59,21 @@ def _shard_map():
     return shard_map
 
 
+def build_global_mesh(axis="shards"):
+    """1-D mesh over the GLOBAL device list, process-major: each
+    process's addressable block is contiguous along the shard axis —
+    exactly what `jax.make_array_from_process_local_data` fills. On a
+    single process this is the same mesh ShardedQueryEngine builds; in
+    multi-controller SPMD (cluster/spmd.py) every process constructs the
+    identical mesh over the identical device order, the requirement for
+    collective programs to line up."""
+    jax, _ = _jax()
+
+    devices = sorted(jax.devices(),
+                     key=lambda d: (d.process_index, d.id))
+    return jax.sharding.Mesh(np.array(devices), (axis,))
+
+
 def _is_multi_device(x):
     """True when `x` is a jax array spanning more than one device."""
     sharding = getattr(x, "sharding", None)

@@ -325,7 +325,7 @@ class QueryCoalescer:
         from ..utils import flightrec
         from ..utils.stats import global_stats
 
-        ex = getattr(self.api.executor, "local", self.api.executor)
+        ex = self.api.batch_executor()
         pending = []  # [(handle, state, members)] launched, unresolved
         while True:
             idle = False
@@ -488,11 +488,17 @@ class API:
             self.resize = None
         # Query coalescer (batched dispatch pipeline): window 0 — the
         # default — disables it entirely and keeps the legacy per-query
-        # path bit-identical. Cluster coordinators never coalesce; the
-        # fan-out legs are where the dispatches happen.
+        # path bit-identical. Cluster coordinators coalesce only when
+        # the SPMD mesh serves (serve-mode != off): eligible batches
+        # then execute as ONE collective step (SpmdBatchRunner); on the
+        # legacy HTTP fan-out path the legs are where dispatches happen,
+        # so coordinator coalescing would only add latency.
         self.coalesce_window = float(coalesce_window or 0.0)
         self.coalesce_max_queue = int(coalesce_max_queue)
-        if self.coalesce_window > 0 and cluster is None:
+        if self.coalesce_window > 0 and (
+                cluster is None
+                or (spmd is not None
+                    and getattr(spmd, "serve_mode", "off") != "off")):
             self._coalescer = QueryCoalescer(
                 self, self.coalesce_window, self.coalesce_max_queue)
         else:
@@ -545,6 +551,39 @@ class API:
         if self.spmd is None:
             raise ApiError("spmd mode not enabled on this node")
         return self.spmd.run_step(step)
+
+    def spmd_stream(self, step):
+        """Enqueue one STREAMED SPMD step (serve-mode on; POST
+        /internal/spmd/stream) — acks before the collective runs."""
+        if self.spmd is None:
+            raise ApiError("spmd mode not enabled on this node")
+        return self.spmd.run_stream(step)
+
+    def batch_executor(self):
+        """The executor the coalescer drains into: the local vmapped
+        batch pipeline on a single node, the SPMD collective batch
+        adapter on a mesh-serving cluster coordinator."""
+        if self.cluster is not None and self.spmd is not None:
+            from ..cluster.spmd import SpmdBatchRunner
+
+            return SpmdBatchRunner(self)
+        return getattr(self.executor, "local", self.executor)
+
+    def spmd_debug(self):
+        """GET /debug/spmd payload."""
+        if self.spmd is None:
+            return {"enabled": False}
+        snap = self.spmd.debug_snapshot()
+        snap["enabled"] = True
+        return snap
+
+    def spmd_set_mode(self, mode):
+        """POST /debug/spmd {"serve_mode": ...}: runtime serve-mode
+        switch (off|on|shadow|http — http forces the HTTP fan-out for
+        same-cluster A/B benching)."""
+        if self.spmd is None:
+            raise ApiError("spmd mode not enabled on this node")
+        return {"serve_mode": self.spmd.set_serve_mode(mode)}
 
     # -- queries ------------------------------------------------------------
 
@@ -1225,6 +1264,8 @@ class API:
             self.ingest.close()
         if self._coalescer is not None:
             self._coalescer.close()
+        if self.spmd is not None:
+            self.spmd.close()
 
     def _broadcast_shards_if_changed(self, index_name):
         """Push this node's per-index available shards to peers when they
@@ -1366,6 +1407,10 @@ class API:
         except HolderError as e:
             raise NotFoundError(str(e)) from e
         self._pushed_shards.pop(name, None)
+        if self.spmd is not None:
+            # mesh-resident stacks of a deleted index must not pin
+            # device memory (gen validation already keeps them unread)
+            self.spmd.mesh_cache.invalidate_index(name)
         if self.cluster is not None:
             self.cluster.drop_remote_index(name)
         if not remote:

@@ -13,9 +13,37 @@ later — and the advertised delay is honored up to the backoff cap."""
 
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.request
+
+# -- HTTP data-plane byte accounting ------------------------------------
+# Response bytes of node-to-node REMOTE query fan-out — the cluster's
+# HTTP DATA plane (result payloads), as opposed to control traffic
+# (step announcements, validation, health). The SPMD serving bench
+# asserts this stays flat while collectives serve: result bytes ride
+# the fabric, not HTTP. Process-wide (every Client instance counts).
+_data_plane_lock = threading.Lock()
+_data_plane_bytes = 0
+
+
+def _note_data_plane(n):
+    global _data_plane_bytes
+    with _data_plane_lock:
+        _data_plane_bytes += int(n)
+
+
+def data_plane_bytes():
+    with _data_plane_lock:
+        return _data_plane_bytes
+
+
+def reset_data_plane_bytes():
+    """Bench/test isolation."""
+    global _data_plane_bytes
+    with _data_plane_lock:
+        _data_plane_bytes = 0
 
 
 class ClientError(Exception):
@@ -152,6 +180,11 @@ class Client:
             if shed is not None:
                 err.shed = shed
             raise err from e
+        if "/query" in path and "remote=true" in path:
+            # JSON-wire remote fan-out: result bytes over HTTP (the
+            # proto wire counts in query_proto, whose path carries no
+            # remote param)
+            _note_data_plane(len(data))
         if ctype.startswith("application/json"):
             return json.loads(data.decode()) if data else None
         return data
@@ -210,6 +243,8 @@ class Client:
             content_type=encoding.CONTENT_TYPE_PROTOBUF,
             deadline=deadline,
             headers=self._query_headers(deadline, query_class))
+        if remote and isinstance(data, (bytes, bytearray)):
+            _note_data_plane(len(data))
         return encoding.decode_query_response(data)
 
     def query(self, index, pql, shards=None, remote=False,
@@ -420,6 +455,17 @@ class Client:
 
         return self._request(
             "POST", "/internal/spmd/step", _json.dumps(step).encode(),
+            content_type="application/json")
+
+    def spmd_stream(self, step):
+        """Announce a STREAMED SPMD step (serve-mode on): the peer
+        enqueues by sequence number and acks immediately — the ack does
+        not wait for the collective, which is what lets the coordinator
+        pipeline announcement N+1 while step N executes."""
+        import json as _json
+
+        return self._request(
+            "POST", "/internal/spmd/stream", _json.dumps(step).encode(),
             content_type="application/json")
 
     def spmd_validate(self, step):

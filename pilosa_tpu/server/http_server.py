@@ -107,6 +107,8 @@ class PilosaHTTPServer:
                   self._get_shard_fragments),
             Route("POST", r"/internal/cluster/message", self._post_message),
             Route("POST", r"/internal/spmd/step", self._post_spmd_step),
+            Route("POST", r"/internal/spmd/stream",
+                  self._post_spmd_stream),
             Route("POST", r"/internal/spmd/validate",
                   self._post_spmd_validate),
             Route("POST", r"/internal/spmd/initiate",
@@ -173,6 +175,8 @@ class PilosaHTTPServer:
                   args=("top",)),
             Route("GET", r"/debug/optimizer", self._get_debug_optimizer),
             Route("GET", r"/debug/fusion", self._get_debug_fusion),
+            Route("GET", r"/debug/spmd", self._get_debug_spmd),
+            Route("POST", r"/debug/spmd", self._post_debug_spmd),
             Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/admission", self._get_debug_admission),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
@@ -543,6 +547,14 @@ class PilosaHTTPServer:
 
         value = self.api.spmd_step(_json.loads(req.body.decode()))
         return {"value": value}
+
+    def _post_spmd_stream(self, req):
+        """Streamed step announcement (serve-mode on): enqueue + ack —
+        the peer's stream runner executes the collective out-of-band,
+        which is what lets the coordinator pipeline the next step."""
+        import json as _json
+
+        return self.api.spmd_stream(_json.loads(req.body.decode()))
 
     def _post_spmd_validate(self, req):
         import json as _json
@@ -924,6 +936,20 @@ class PilosaHTTPServer:
         from ..exec import fusion
 
         return fusion.snapshot()
+
+    def _get_debug_spmd(self, req):
+        """Mesh serving state: serve mode + mesh shape, per-node step
+        lifecycle counters (announced/entered/exited — the wedge
+        classifier's input), stream queue state, mesh-resident cache
+        stats, and the HTTP data-plane byte counter."""
+        return self.api.spmd_debug()
+
+    def _post_debug_spmd(self, req):
+        """Runtime serve-mode switch: {"serve_mode": off|on|shadow|http}
+        ("http" forces the HTTP fan-out path for A/B benching on the
+        same cluster)."""
+        body = req.json() or {}
+        return self.api.spmd_set_mode(body.get("serve_mode"))
 
     def _get_debug_slo(self, req):
         """SLO objectives with fast/slow-window error-budget burn rates

@@ -41,6 +41,15 @@ Event taxonomy (kind prefixes; see docs/architecture.md):
   watchdog.*   stall trips
   slo.burn_alert  error-budget burn over threshold in BOTH windows
                   (utils/workload.py SloEngine; edge-triggered)
+  spmd.*       collective step lifecycle (cluster/spmd.py): step_announce
+               when the coordinator assigns a step-seq and fans it out,
+               step_enter/step_exit on EVERY process around the collective
+               program (tags: seq, ok), stream_resync when a step-stream
+               gap times out and the runner skips ahead. The enter/exit
+               pairing is what lets bench.py distinguish "peer never
+               entered the collective" from "collective hung".
+  fusion.compile  whole-plan (and mesh collective) program compiles with
+                  wall time; mesh programs carry a `mesh` tag
 """
 
 import collections

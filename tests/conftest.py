@@ -27,6 +27,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: boots multi-process clusters / exceeds the tier-1 time "
+        "budget (excluded by the default -m 'not slow' run; "
+        "make test-spmd-mesh runs them)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,7 +1,13 @@
 """In-process server harness (reference: test/pilosa.go MustRunCluster —
 boots real servers on ephemeral ports)."""
 
+import json
+import os
+import socket
+import subprocess
+import sys
 import tempfile
+import time
 
 from pilosa_tpu.core import Holder
 from pilosa_tpu.server import API, Client, PilosaHTTPServer
@@ -92,3 +98,117 @@ class ClusterHarness:
     def close(self):
         for h in self.nodes:
             h.close()
+
+
+class SpmdMeshCluster:
+    """2 real server processes forming a gloo-backed global CPU mesh
+    (--spmd-serve on --spmd-cpu-collectives gloo). Unlike the bare
+    --spmd harness (tests/test_spmd.py), gloo gives the CPU backend REAL
+    cross-process collectives, so the mesh-resident serving plane forms
+    even on single-chip CI hosts: 2 virtual devices per process -> a
+    4-device mesh whose psum actually crosses the process boundary.
+
+    Used by tests/test_spmd_mesh.py and the bench_suite spmd_serving
+    leg (same-cluster A/B via the runtime POST /debug/spmd switch)."""
+
+    def __init__(self, n=2, serve_mode="on", coalesce_window="40ms",
+                 extra_flags=()):
+        ports = _free_ports(n + 1)
+        self.ports, spmd_port = ports[:n], ports[n]
+        hosts = ",".join(f"127.0.0.1:{p}" for p in self.ports)
+        self.dirs = [tempfile.mkdtemp(prefix="pilosa-mesh-")
+                     for _ in range(n)]
+        self.procs = []
+        self.logs = []
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
+        flags = ["--spmd", "--spmd-port", str(spmd_port),
+                 "--spmd-serve", serve_mode,
+                 "--spmd-cpu-collectives", "gloo",
+                 "--fusion", "on",
+                 "--coalesce-window", coalesce_window,
+                 *extra_flags]
+        for i, port in enumerate(self.ports):
+            log = open(os.path.join(self.dirs[i], "server.log"), "w")
+            self.logs.append(log)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                 "--bind", f"127.0.0.1:{port}",
+                 "--data-dir", self.dirs[i],
+                 "--cluster-hosts", hosts,
+                 "--replicas", "1"] + flags,
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+        self.clients = [Client(f"http://127.0.0.1:{p}", timeout=120)
+                        for p in self.ports]
+        # the cluster sorts nodes by id: the coordinator (step initiator)
+        # is the lexically-smallest host:port
+        self.coord = min(range(n),
+                         key=lambda i: f"127.0.0.1:{self.ports[i]}")
+
+    def wait_ready(self, timeout=240):
+        deadline = time.time() + timeout
+        pending = set(range(len(self.procs)))
+        while pending and time.time() < deadline:
+            for i in list(pending):
+                if self.procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"node {i} exited: " + self.tail(i))
+                try:
+                    self.clients[i]._request("GET", "/status")
+                    pending.discard(i)
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        if pending:
+            raise TimeoutError(
+                f"nodes {sorted(pending)} not ready: "
+                + "; ".join(self.tail(i) for i in pending))
+
+    def set_mode(self, mode):
+        """Runtime serve-mode switch on EVERY node (POST /debug/spmd)."""
+        for cl in self.clients:
+            cl._request("POST", "/debug/spmd",
+                        body=json.dumps({"serve_mode": mode}).encode())
+
+    def debug(self, i):
+        return self.clients[i]._request("GET", "/debug/spmd")
+
+    def stats(self, i):
+        return self.clients[i]._request("GET", "/internal/spmd/stats")
+
+    def tail(self, i, n=2000):
+        self.logs[i].flush()
+        with open(self.logs[i].name) as f:
+            return f.read()[-n:]
+
+    def close(self):
+        for p in self.procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self.logs:
+            log.close()
+        import shutil
+
+        for d in self.dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
