@@ -17,12 +17,12 @@ DISTRIBUTED = tests/test_clusterproc.py tests/test_spmd.py \
 	test-flightrec test-devhealth test-explain test-durability \
 	test-workload test-batching test-containers test-adaptive \
 	test-ingest test-admission test-fusion test-incident \
-	test-spmd-mesh lint bench-cpu
+	test-spmd-mesh test-meshobs lint bench-cpu
 
 test: test-core test-distributed test-flightrec test-devhealth \
 	test-explain test-durability test-workload test-batching \
 	test-containers test-adaptive test-ingest test-admission \
-	test-fusion test-incident test-spmd-mesh
+	test-fusion test-incident test-spmd-mesh test-meshobs
 
 test-core:
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
@@ -128,6 +128,15 @@ test-incident:
 test-spmd-mesh:
 	$(PY) -m pytest tests/test_spmd_mesh.py tests/test_spmd_serve.py \
 		-q -p no:cacheprovider
+
+# Mesh observatory surface: the step-clock residual-fold invariant
+# (phase sum == step wall, exactly), the bounded step ring, envelope
+# clock-skew correction, the straggler-attribution oracle under
+# synthetic skew, stream-gap onset events + stall accounting, and the
+# collective_stall incident trigger. All fast in-process units; the
+# live 2-process merged-timeline case rides in test-spmd-mesh.
+test-meshobs:
+	$(PY) -m pytest tests/test_meshobs.py $(PYTEST_FLAGS)
 
 # ruff when available; otherwise fall back to a bytecode-compile pass so
 # the target still catches syntax errors on a bare container (the image

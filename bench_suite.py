@@ -2573,6 +2573,144 @@ def bench_spmd_serving():
         "disabled_overhead_pct": round(overhead_pct, 4)})
 
 
+# --------------------------------------------------------------- config 21
+
+def bench_meshobs_overhead():
+    """Mesh observatory acceptance leg (config: meshobs_overhead).
+
+    Three claims, one JSON line:
+    1. The per-step instrumentation the observatory adds to every
+       collective step — the _StepClock (create + 5 marks + residual
+       fold) and _note_step (rec build, bounded ring append, per-phase
+       histogram timings) — costs <2% of the median LIVE step wall on
+       the 2-process gloo mesh. Measured as the raw hook sequence, not
+       a with/without delta, so the gate is an upper bound.
+    2. With --spmd-serve off the only per-query costs are the fused
+       entry decline and the no-clock _mark_phase early-out, <2% of an
+       api_nop query even charged at one full set per query.
+    3. On the live mesh the merged /debug/spmd/steps timeline is
+       self-consistent: every peer's phases sum to its step wall within
+       5% residual, and the healthy same-host mesh flags ZERO
+       stragglers (the noise floor holds against scheduler jitter).
+    """
+    import importlib
+    import statistics as _stats
+    import sys as _sys
+
+    from pilosa_tpu.cluster.spmd import SpmdDataPlane, _StepClock
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    # -- claim 2 first (in-process, fast-fail): serve=off hooks ----------
+    platform, holder, api, ex = _env()
+    api.create_index("mobs")
+    api.create_field("mobs", "a")
+    idx = holder.index("mobs")
+    rng = np.random.default_rng(19)
+    cols = rng.choice(2 * SHARD_WIDTH, size=50_000,
+                      replace=False).astype(np.uint64)
+    idx.field("a").import_bits(
+        rng.integers(0, 4, size=len(cols)).astype(np.uint64), cols)
+    api.executor = ex
+    pql = "Count(Row(a=1))"
+    api.query("mobs", pql)  # warm stacks + compile
+    n_q = 50 if platform == "cpu" else 200
+    t0 = time.perf_counter()
+    for _ in range(n_q):
+        api.query("mobs", pql)
+    query_ms = (time.perf_counter() - t0) / n_q * 1000
+
+    off = SpmdDataPlane(None, None, None, serve_mode="off")
+    n_probe = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        off.maybe_execute_fused(None, None, None)  # executor hook
+        off._mark_phase("psum")  # no active clock: the early-out path
+    off_ns = (time.perf_counter() - t0) / n_probe * 1e9
+    off_pct = off_ns / 1e6 / query_ms * 100
+    _close(holder)
+    assert off_pct < 2.0, (
+        f"disabled mesh-observatory hooks cost {off_pct:.3f}% of an "
+        "api_nop query — no longer an always-on-safe instrument")
+
+    # -- claim 1 hook cost: the exact per-step sequence PR 19 added -----
+    obs = SpmdDataPlane(None, None, None, serve_mode="on")
+    n_steps = 5_000
+    started = time.time()
+    t0 = time.perf_counter()
+    for i in range(1, n_steps + 1):
+        clk = _StepClock()
+        clk.mark("announce_recv")
+        clk.mark("stack_gather")
+        clk.mark("device_enter")
+        clk.mark("psum")
+        clk.mark("result_fetch")
+        wall = clk.close()
+        obs._note_step({"index": "i", "kind": "count"}, i, started, wall,
+                       clk.phases, True)
+    obs_ns = (time.perf_counter() - t0) / n_steps * 1e9
+    assert len(obs.steps_local()["steps"]) == obs.STEP_RING_SIZE
+
+    # -- claims 1 + 3: live 2-process gloo mesh -------------------------
+    _sys.path.insert(0, ".")
+    harness = importlib.import_module("tests.harness")
+    cluster = harness.SpmdMeshCluster(2, coalesce_window="10ms")
+    try:
+        cluster.wait_ready()
+        coord = cluster.clients[cluster.coord]
+        coord.create_index("mo")
+        coord.create_field("mo", "f")
+        time.sleep(1.0)  # DDL broadcast settles
+        bits = [s * SHARD_WIDTH + i for s in range(4) for i in range(500)]
+        coord.import_bits("mo", "f", [1] * len(bits), bits)
+        cluster.set_mode("on")
+        for _ in range(4):  # warm: cache + programs + epochs
+            coord.query("mo", "Count(Row(f=1))")
+        marker = cluster.debug(cluster.coord)["steps"]["last_seq"]
+        n_meas = 48
+        for _ in range(n_meas):
+            coord.query("mo", "Count(Row(f=1))")
+        tl = coord._request("GET", "/debug/spmd/steps?limit=128")
+    finally:
+        cluster.close()
+
+    walls, residual_pcts, stragglers = [], [], 0
+    fresh = [s for s in tl["steps"] if s["seq"] > marker]
+    assert len(fresh) >= n_meas // 2, "step ring lost the measured window"
+    for s in fresh:
+        assert len(s["peers"]) == 2, s
+        stragglers += len(s["stragglers"])
+        for peer in s["peers"].values():
+            walls.append(peer["wall_seconds"])
+            if peer["wall_seconds"] > 0:
+                residual_pcts.append(
+                    abs(sum(peer["phases"].values()) - peer["wall_seconds"])
+                    / peer["wall_seconds"] * 100)
+    med_wall_ms = _stats.median(walls) * 1000
+    step_pct = obs_ns / 1e6 / med_wall_ms * 100
+    assert step_pct < 2.0, (
+        f"per-step observatory instrumentation costs {step_pct:.3f}% of "
+        f"the median live step wall ({med_wall_ms:.3f}ms) — too hot for "
+        "an always-on clock")
+    max_residual = max(residual_pcts) if residual_pcts else 0.0
+    assert max_residual <= 5.0, (
+        f"phase sums drift {max_residual:.2f}% from step walls — the "
+        "residual fold is broken")
+    assert stragglers == 0, (
+        f"{stragglers} straggler flags on a healthy same-host mesh — "
+        "the noise floor no longer holds")
+
+    _emit("meshobs_step_hook_pct", step_pct, 2.0, {
+        "platform": "cpu-mesh(2proc x 2dev, gloo)",
+        "per_step_hook_ns": round(obs_ns, 1),
+        "median_live_step_wall_ms": round(med_wall_ms, 3),
+        "steps_sampled": len(fresh),
+        "max_phase_residual_pct": round(max_residual, 4),
+        "straggler_flags": stragglers,
+        "api_nop_ms": round(query_ms, 3),
+        "disabled_hook_set_ns": round(off_ns, 1),
+        "disabled_overhead_pct": round(off_pct, 4)})
+
+
 CONFIGS = {
     "star_trace": bench_star_trace,
     "topn_groupby": bench_topn_groupby,
@@ -2594,6 +2732,7 @@ CONFIGS = {
     "fusion": bench_fusion,
     "incident_overhead": bench_incident_overhead,
     "spmd_serving": bench_spmd_serving,
+    "meshobs_overhead": bench_meshobs_overhead,
 }
 
 

@@ -235,15 +235,24 @@ def cmd_server(args):
     adm_qt = config.get("admission-queue-timeout")
     spmd = None
     if spmd_requested and cluster is not None:
+        from .cluster import spmd as spmd_mod
         from .cluster.spmd import SpmdDataPlane
         from .server import Client as _SpmdClient
 
         from .utils.logger import StandardLogger
 
+        sgt = config.get("spmd-stream-gap-timeout")
         spmd = SpmdDataPlane(holder, cluster, _SpmdClient,
                              logger=StandardLogger(),
                              serve_mode=str(
-                                 config.get("spmd-serve", "off")).lower())
+                                 config.get("spmd-serve", "off")).lower(),
+                             stream_gap_timeout=parse_duration(str(sgt))
+                             if sgt else None)
+        # mesh observatory: expose the serving plane to the incident
+        # `spmd` collector and hang the pipeline-occupancy gauges on the
+        # process stats client (one long-lived plane per server process)
+        spmd_mod.set_active_plane(spmd)
+        spmd.register_gauges()
     api = API(holder, cluster=cluster,
               long_query_time=parse_duration(lqt) if lqt else None,
               max_writes_per_request=int(mwpr),
@@ -871,7 +880,8 @@ def _apply_server_flags(config, args):
     once via viper for every subcommand)."""
     for flag in ("bind", "data_dir", "cluster_hosts", "node_id",
                  "replicas", "spmd_port", "spmd_serve",
-                 "spmd_cpu_collectives", "long_query_time",
+                 "spmd_cpu_collectives", "spmd_stream_gap_timeout",
+                 "long_query_time",
                  "max_writes_per_request", "tracing", "workers",
                  "flight_recorder_size", "watchdog_deadline",
                  "incident_dir", "incident_max", "metrics_exemplars",
@@ -1034,6 +1044,12 @@ def main(argv=None):
                         "--spmd (gloo enables real cross-process CPU "
                         "collectives, e.g. the 2-process test harness; "
                         "default none)")
+    p.add_argument("--spmd-stream-gap-timeout", default=None,
+                   help="how long a peer's step-stream runner waits on "
+                        "a sequence gap before resyncing past it "
+                        "(duration, default 30s); gap ONSET fires the "
+                        "spmd.stream_gap flightrec event and a "
+                        "collective_stall incident bundle immediately")
     p.add_argument("--bind", default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config", default=None)
@@ -1277,6 +1293,7 @@ def main(argv=None):
                    choices=("off", "on", "shadow"))
     p.add_argument("--spmd-cpu-collectives", default=None,
                    choices=("none", "gloo"))
+    p.add_argument("--spmd-stream-gap-timeout", default=None)
     p.add_argument("--long-query-time", default=None)
     p.add_argument("--max-writes-per-request", type=int, default=None)
     p.add_argument("--tracing", default=None, choices=["none", "memory"])

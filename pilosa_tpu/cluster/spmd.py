@@ -44,11 +44,22 @@ Execution model (multi-controller SPMD):
 
 Count totals use the framework-wide (hi, lo) int32 split reduce
 (ops.bitplane.hi_lo) — exact past 2^31 bits without x64.
+
+Mesh observatory (PR 19): every process runs a per-step phase clock
+(_StepClock, mirroring the PR-6 dispatch _PhaseClock contract:
+residual-folded so per-phase seconds sum EXACTLY to the step wall) and
+records each step into a bounded ring. The coordinator assembles the
+rings into one skew-corrected cross-node timeline
+(GET /debug/spmd/steps) with per-phase straggler attribution — the
+evidence layer the spmd_never_entered / spmd_collective_hung wedge
+classes were missing.
 """
 
 import itertools
+import statistics
 import threading
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -81,6 +92,122 @@ def sig_from_wire(wire):
     if wire[0] == "leaf":
         return ("leaf", int(wire[1]))
     return (wire[0], tuple(sig_from_wire(s) for s in wire[1]))
+
+
+# -- mesh observatory ---------------------------------------------------------
+
+#: step-phase taxonomy (GET /debug/spmd/steps; docs/architecture.md):
+#: announce_recv — announcement receipt to collective entry (stream-queue
+#: wait + step-lock wait on peers; fan-out time on the coordinator);
+#: stack_gather — host fragment gather + make_array_from_process_local_data
+#: for every leaf/BSI/row stack; device_enter — the jitted collective
+#: program call returning its (possibly async) output handles; psum —
+#: block_until_ready on those handles, i.e. the collective rendezvous +
+#: execution (a straggling peer shows up HERE on everyone else); result_
+#: fetch — device-to-host conversion of the replicated outputs; exit —
+#: residual-folded terminal phase (decode + lifecycle bookkeeping), which
+#: absorbs the fold so the phases sum EXACTLY to the step wall.
+STEP_PHASES = ("announce_recv", "stack_gather", "device_enter", "psum",
+               "result_fetch", "exit")
+
+
+class _StepClock:
+    """Phase marks within one collective step — the PR-6 _PhaseClock
+    contract (exec/stacked.py) lifted to the step plane: `mark(phase)`
+    attributes the time since the previous mark (or the announcement
+    receipt) to `phase`; `close()` folds any residual into the terminal
+    phase so the per-phase seconds sum EXACTLY to the step wall (the
+    bench meshobs leg asserts the 5% version of this cross-process)."""
+
+    __slots__ = ("t0", "_t", "phases")
+
+    def __init__(self, t0=None):
+        now = time.perf_counter()
+        self.t0 = self._t = now if t0 is None else t0
+        self.phases = []
+
+    def mark(self, phase):
+        now = time.perf_counter()
+        self.phases.append([phase, now - self._t])
+        self._t = now
+
+    def close(self, phase="exit"):
+        """Fold the residual into `phase` and return the step wall."""
+        self.mark(phase)
+        return self._t - self.t0
+
+
+def envelope_skew(t_send, t_recv, remote_now):
+    """NTP-style clock-offset estimate (remote - local, seconds) from one
+    RPC envelope: the peer stamped `remote_now` (its wall clock) while
+    handling a request we sent at local wall time `t_send` and answered
+    at `t_recv`. Assuming symmetric network delay (the same assumption
+    as tracing.estimate_skew, which derives theta from span pairs), the
+    remote stamp corresponds to the local midpoint of the envelope."""
+    return remote_now - (t_send + t_recv) / 2.0
+
+
+def attribute_stragglers(peers_phases, factor, noise_floor):
+    """Per-phase straggler attribution for ONE step's merged per-peer
+    phase walls. `peers_phases`: {node_id: {phase: seconds}}. A node is
+    the phase's straggler when its wall is the slowest AND exceeds the
+    median of the OTHER peers by `factor` (excluding the candidate —
+    on a 2-node mesh a median over both would dilute the straggler's
+    own wall into the baseline) AND by more than `noise_floor` seconds
+    in absolute terms (so microsecond jitter between healthy peers
+    never flags). Returns [{phase, node, seconds, median_seconds,
+    ratio}]."""
+    flags = []
+    phases = set()
+    for ph in peers_phases.values():
+        phases.update(ph)
+    for phase in sorted(phases):
+        walls = {node: ph[phase] for node, ph in peers_phases.items()
+                 if phase in ph}
+        if len(walls) < 2:
+            continue
+        worst_node = max(walls, key=walls.get)
+        worst = walls[worst_node]
+        med = statistics.median(v for n, v in walls.items()
+                                if n != worst_node)
+        if worst > med * factor and worst - med > noise_floor:
+            flags.append({
+                "phase": phase,
+                "node": worst_node,
+                "seconds": round(worst, 6),
+                "median_seconds": round(med, 6),
+                "ratio": round(worst / med, 2) if med > 0 else None,
+            })
+    return flags
+
+
+#: the serving process's data plane (set by cli.cmd_server) — what the
+#: incident-autopsy `spmd` collector snapshots into EVERY postmortem
+#: bundle without holding an instance handle (utils/incident.py)
+_active_plane = None
+
+
+def set_active_plane(plane):
+    global _active_plane
+    _active_plane = plane
+    return plane
+
+
+def active_plane():
+    return _active_plane
+
+
+def observatory_snapshot():
+    """Incident-bundle collector payload: the active plane's full
+    observatory state (step ring + phase tables + a best-effort
+    cross-node timeline), or the disabled stub."""
+    plane = _active_plane
+    if plane is None:
+        return {"enabled": False}
+    try:
+        return dict(plane.incident_snapshot(), enabled=True)
+    except Exception as e:  # noqa: BLE001 — never fail the bundle
+        return {"enabled": True, "error": str(e)}
 
 
 class SpmdDataPlane:
@@ -129,9 +256,21 @@ class SpmdDataPlane:
     #: wedge the stream forever; the coordinator's collective for the
     #: lost step fails via the distributed-runtime timeout and falls back)
     STREAM_GAP_TIMEOUT = 30
+    #: bounded per-node step ring (mesh observatory): most recent steps
+    #: with per-phase walls, what GET /debug/spmd/steps merges cross-node
+    STEP_RING_SIZE = 256
+    #: a node is a phase's straggler when its wall exceeds the peer
+    #: median by this factor AND by STRAGGLER_NOISE_FLOOR seconds in
+    #: absolute terms (2x of a 50us gather is jitter, not a straggler)
+    STRAGGLER_FACTOR = 2.0
+    STRAGGLER_NOISE_FLOOR = 0.025
+    #: edge-trigger memory: (seq, node, phase) keys already counted /
+    #: flightrec'd, so repeated GET /debug/spmd/steps scrapes of the same
+    #: ring don't re-fire events (bounded FIFO)
+    STRAGGLER_FLAGS_MAX = 1024
 
     def __init__(self, holder, cluster, client_factory, logger=None,
-                 serve_mode="off"):
+                 serve_mode="off", stream_gap_timeout=None):
         self.holder = holder
         self.cluster = cluster
         self.client_factory = client_factory
@@ -164,6 +303,27 @@ class SpmdDataPlane:
         self._stream_seq_out = 0
         self.stream_errors = 0
         self.stream_resyncs = 0
+        # --spmd-stream-gap-timeout override (satellite: a 30s silent
+        # stall was invisible until resync; ops can now shorten the fuse)
+        if stream_gap_timeout is not None and stream_gap_timeout > 0:
+            self.STREAM_GAP_TIMEOUT = float(stream_gap_timeout)
+        # -- mesh observatory state ------------------------------------
+        # Separate lock from self._lock: the whole point of the step ring
+        # is reading it WHILE a collective is wedged holding _lock.
+        self._obs_lock = threading.Lock()
+        self._step_ring = deque(maxlen=self.STEP_RING_SIZE)
+        self._phase_totals = {}  # phase -> [count, seconds]
+        # the in-flight step's clock; only the step-executing thread
+        # writes it (one step at a time per process under _lock)
+        self._step_clock = None
+        # last completed step record, thread-local: the coordinator's
+        # query thread IS its step-executing thread, so ANALYZE/profile
+        # grafting reads its own step's phases race-free under load
+        self._step_tls = threading.local()
+        self.gap_onsets = 0
+        self.gap_stall_seconds = 0.0
+        self._straggler_flags = OrderedDict()  # (seq, node, phase) -> 1
+        self.straggler_flags_total = 0
         # per-node step lifecycle counters (satellite: wedge root-cause —
         # announced>entered means a peer never reached the collective,
         # entered>exited means the collective itself hung)
@@ -365,13 +525,15 @@ class SpmdDataPlane:
         }[kind]
         from ..utils import tracing
 
+        before = getattr(self._step_tls, "rec", None)
         try:
             # the collective data plane is otherwise invisible to a query
             # profile — this span records that the query went over SPMD
             # (and how long the collective step took) instead of HTTP
             with tracing.start_span("spmd.step", kind=kind,
-                                    shards=len(shards)):
+                                    shards=len(shards)) as span:
                 result = try_fn(idx, call, list(shards))
+                self._graft_span(span, before=before)
         except Exception as e:
             # Watchdog: a wedged/failed collective (e.g. a peer that died
             # inside the amortized-validation window while still marked
@@ -522,10 +684,19 @@ class SpmdDataPlane:
         peer is still inside step N (the collective itself is the
         synchronization; the old blocking join double-paid it in HTTP
         round-trip time)."""
-        from ..utils import flightrec
+        from ..utils import flightrec, tracing
 
         streamed = self.serve_mode == "on"
+        # carry the coordinator's trace id so every node's step record —
+        # and the merged /debug/spmd/steps timeline — joins back to the
+        # query (?profile=true span graft, --metrics-exemplars buckets)
+        span = tracing.current_span()
+        if span is not None and "trace" not in step:
+            step["trace"] = span.trace_id
         with self._lock:
+            # announce_recv t0 on the coordinator: announcement fan-out +
+            # own step-lock wait (peers overwrite with their receipt time)
+            step["_recv_t"] = time.perf_counter()
             self._step_id += 1
             step["step"] = self._step_id
             if streamed:
@@ -585,25 +756,105 @@ class SpmdDataPlane:
         wedge classifier reads (bench._classify_wedge): a node whose
         flightrec shows announce-without-enter never reached the
         collective (control-plane loss); enter-without-exit means the
-        collective itself hung. Caller holds self._lock."""
+        collective itself hung. Caller holds self._lock.
+
+        Mesh observatory: runs the step under a _StepClock (t0 = the
+        step's announcement-receipt stamp, so announce_recv covers
+        stream-queue + lock wait) and under a flightrec watchdog — a
+        collective stuck past STEP_TIMEOUT now trips a collective_stall
+        incident bundle instead of hanging silently."""
         from ..utils import flightrec
 
         seq = int(step.get("seq") or step.get("step") or 0)
+        kind = step.get("kind", "count")
+        started = time.time()
+        clk = _StepClock(t0=step.pop("_recv_t", None))
+        clk.mark("announce_recv")
+        self._step_clock = clk
         self.steps_entered += 1
         self.last_seq = max(self.last_seq, seq)
         flightrec.record("spmd.step_enter", index=step.get("index", ""),
-                         op=step.get("kind", "count"), seq=seq)
+                         op=kind, seq=seq)
+        token = flightrec.watch_begin("spmd.step", seq=seq, op=kind,
+                                      index=step.get("index", ""))
         ok = False
         try:
             result = self._run_step_locked(step)
             ok = True
             return result
         finally:
+            flightrec.watch_end(token)
+            self._step_clock = None
+            wall = clk.close("exit")
             self.steps_exited += 1
             flightrec.record("spmd.step_exit",
                              index=step.get("index", ""),
-                             op=step.get("kind", "count"), seq=seq,
+                             op=kind, seq=seq,
                              ok=ok)
+            self._note_step(step, seq, started, wall, clk.phases, ok)
+
+    def _mark_phase(self, phase):
+        """Attribute time-since-last-mark to `phase` on the in-flight
+        step's clock (no-op outside a step; the clock is only ever set
+        by the thread holding self._lock)."""
+        clk = self._step_clock
+        if clk is not None:
+            clk.mark(phase)
+
+    def _note_step(self, step, seq, started, wall, phase_marks, ok):
+        """Fold one finished step into the observatory: the bounded step
+        ring + per-phase totals (under _obs_lock so /debug readers never
+        touch the step lock) and spmd_step_seconds{phase} timings with
+        the step's trace id as the exemplar."""
+        phases = {}
+        for name, secs in phase_marks:
+            phases[name] = phases.get(name, 0.0) + secs
+        rec = {
+            "seq": seq,
+            "step": step.get("step", 0),
+            "kind": step.get("kind", "count"),
+            "index": step.get("index", ""),
+            "start": started,
+            "wall_seconds": round(wall, 6),
+            "ok": ok,
+            "phases": {p: round(s, 6) for p, s in phases.items()},
+        }
+        trace = step.get("trace")
+        if trace:
+            rec["trace"] = trace
+        with self._obs_lock:
+            self._step_ring.append(rec)
+            for name, secs in phases.items():
+                tot = self._phase_totals.get(name)
+                if tot is None:
+                    tot = self._phase_totals[name] = [0, 0.0]
+                tot[0] += 1
+                tot[1] += secs
+        self._step_tls.rec = rec
+        try:
+            from ..utils.stats import global_stats
+
+            for name, secs in phases.items():
+                global_stats.timing("spmd_step_seconds", secs,
+                                    tags={"phase": name}, trace_id=trace)
+            global_stats.timing("spmd_step_wall_seconds", wall,
+                                trace_id=trace)
+        except Exception:  # noqa: BLE001 — stats must never fail a step
+            pass
+
+    def _graft_span(self, span, before=None):
+        """Tag the query's spmd.step span with the per-phase walls of
+        the step THIS thread just executed, so ?profile=true shows where
+        collective wall went. `before` (the thread-local rec prior to
+        execution) guards the forwarded case, where no local step ran."""
+        if span is None:
+            return
+        rec = getattr(self._step_tls, "rec", None)
+        if rec is None or rec is before:
+            return
+        span.set_tag("phases_ms", {p: round(s * 1000, 3)
+                                   for p, s in rec["phases"].items()})
+        span.set_tag("step_seq", rec["seq"])
 
     def _try_count(self, idx, call, shards):
         """Count(call) merged over the global mesh, or None to fall back
@@ -691,8 +942,9 @@ class SpmdDataPlane:
 
         try:
             with tracing.start_span("spmd.step", kind="count_batch",
-                                    shards=len(shards), batch=k):
+                                    shards=len(shards), batch=k) as span:
                 counts = self._execute_step(step)
+                self._graft_span(span)
         except Exception as e:
             self.fallbacks += 1
             self._count_epochs.pop(idx.name, None)
@@ -753,8 +1005,9 @@ class SpmdDataPlane:
         t0 = _time.perf_counter()
         try:
             with tracing.start_span("spmd.step", kind="fused",
-                                    shards=len(shards), batch=k):
+                                    shards=len(shards), batch=k) as span:
                 counts = self._execute_step(step)
+                self._graft_span(span)
         except Exception as e:
             self.fallbacks += 1
             self._count_epochs.pop(idx.name, None)
@@ -834,6 +1087,7 @@ class SpmdDataPlane:
             return False, None, None
         import time as _time
 
+        before = getattr(self._step_tls, "rec", None)
         t0 = _time.perf_counter()
         used, result = self.maybe_execute(idx, call, shards)
         if not used:
@@ -856,6 +1110,16 @@ class SpmdDataPlane:
                 "children": [],
             },
         }
+        # mesh observatory: this thread just executed the coordinator's
+        # half of the step (the query thread IS the step thread), so its
+        # thread-local step record carries the per-phase walls — graft
+        # them under the collective node's annotations. `rec is before`
+        # means no local step ran (the call was forwarded): skip.
+        rec = getattr(self._step_tls, "rec", None)
+        if rec is not None and rec is not before:
+            entry["plan"]["annotations"]["phases_ms"] = {
+                p: round(s * 1000, 3) for p, s in rec["phases"].items()}
+            entry["plan"]["annotations"]["step_seq"] = rec["seq"]
         return True, result, entry
 
     def _membership_epoch(self):
@@ -1221,6 +1485,11 @@ class SpmdDataPlane:
     def run_step(self, step):
         """HTTP-handler entry for peer processes (blocking legacy
         announcements, serve_mode != on)."""
+        # observatory t0: overwrite unconditionally — any coordinator
+        # stamp that leaked over the wire is from a different process's
+        # perf_counter and meaningless here; announce_recv then measures
+        # this node's step-lock wait
+        step["_recv_t"] = time.perf_counter()
         with self._lock:
             return self._enter_exit_run(step)
 
@@ -1230,6 +1499,9 @@ class SpmdDataPlane:
         runner thread executes steps in seq order, so the coordinator's
         announcing thread never blocks on this peer's collective."""
         seq = int(step["seq"])
+        # observatory t0 at ENQUEUE: announce_recv then measures the
+        # stream-queue wait + step-lock wait (pipeline occupancy per step)
+        step["_recv_t"] = time.perf_counter()
         with self._stream_cond:
             self._stream_queue[seq] = step
             if self._stream_next is None:
@@ -1257,24 +1529,43 @@ class SpmdDataPlane:
         step already failed via the distributed-runtime timeout and fell
         back to HTTP, so skipping it here preserves the identical
         program order on every process for the steps that DID run."""
-        from ..utils import flightrec
+        from ..utils import flightrec, incident
 
         while True:
             with self._stream_cond:
                 deadline = None
+                gap_started = None
                 while not self._stream_closed:
                     nxt = self._stream_next
                     if nxt is not None and nxt in self._stream_queue:
                         break
                     if self._stream_queue:
-                        import time as _time
-
-                        now = _time.monotonic()
+                        now = time.monotonic()
                         if deadline is None:
+                            # gap ONSET: later steps queued but the
+                            # expected seq is missing. Announce it NOW —
+                            # a silent STREAM_GAP_TIMEOUT stall was
+                            # previously invisible until the resync —
+                            # and trigger the collective_stall autopsy
+                            # so every peer's step ring is captured
+                            # while the gap is still open.
                             deadline = now + self.STREAM_GAP_TIMEOUT
+                            gap_started = now
+                            self.gap_onsets += 1
+                            flightrec.record(
+                                "spmd.stream_gap", expected=nxt,
+                                queued=len(self._stream_queue),
+                                timeout_seconds=self.STREAM_GAP_TIMEOUT)
+                            incident.maybe_trigger(
+                                "collective_stall", cause="stream_gap",
+                                expected_seq=nxt if nxt is not None
+                                else -1,
+                                queued=len(self._stream_queue))
                         if now >= deadline:
                             resync = min(self._stream_queue)
                             self.stream_resyncs += 1
+                            self.gap_stall_seconds += now - gap_started
+                            gap_started = None
                             flightrec.record(
                                 "spmd.stream_resync",
                                 expected=nxt, resync=resync)
@@ -1286,7 +1577,13 @@ class SpmdDataPlane:
                         self._stream_cond.wait(deadline - now)
                     else:
                         deadline = None
+                        gap_started = None
                         self._stream_cond.wait(1.0)
+                if gap_started is not None:
+                    # gap closed by arrival (or shutdown): account the
+                    # stall time the pipeline spent blocked on it
+                    self.gap_stall_seconds += time.monotonic() \
+                        - gap_started
                 if self._stream_closed:
                     return
                 step = self._stream_queue.pop(self._stream_next)
@@ -1469,14 +1766,23 @@ class SpmdDataPlane:
         return arrays, global_shape
 
     def _run_count_step(self, idx, step):
-        sig = sig_from_wire(step["sig"])
-        arrays, _ = self._leaf_arrays(idx, step)
-        fn = self._count_fn(sig, len(arrays))
-        hi, lo = fn(*arrays)
-        self.steps_run += 1
+        import jax
+
         from ..ops.bitplane import combine_hi_lo
 
-        return int(combine_hi_lo(hi, lo))
+        sig = sig_from_wire(step["sig"])
+        arrays, _ = self._leaf_arrays(idx, step)
+        self._mark_phase("stack_gather")
+        fn = self._count_fn(sig, len(arrays))
+        out = fn(*arrays)
+        self._mark_phase("device_enter")  # compile lands here (cold key)
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
+        self.steps_run += 1
+        hi, lo = out
+        result = int(combine_hi_lo(hi, lo))
+        self._mark_phase("result_fetch")
+        return result
 
     def _run_count_batch_step(self, idx, step):
         """K Count plans in ONE collective step: gather every plan's
@@ -1485,6 +1791,8 @@ class SpmdDataPlane:
         program — same-signature plans vmapped over a stacked leaf axis —
         and all-reduce all K per-shard popcounts together. One
         announcement, one program, one psum for the whole batch."""
+        import jax
+
         from ..ops.bitplane import combine_hi_lo
 
         sigs = []
@@ -1497,12 +1805,19 @@ class SpmdDataPlane:
             arrays, _ = self._leaf_arrays(idx, sub)
             arities.append(len(arrays))
             all_arrays.extend(arrays)
+        self._mark_phase("stack_gather")
         fn = self._count_batch_fn(tuple(sigs), tuple(arities))
-        hilo = np.asarray(fn(*all_arrays))  # [2, K]: one host transfer
+        out = fn(*all_arrays)
+        self._mark_phase("device_enter")
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
         self.steps_run += 1
         self.batch_steps += 1
-        return [int(combine_hi_lo(int(h), int(l)))
-                for h, l in zip(hilo[0], hilo[1])]
+        hilo = np.asarray(out)  # [2, K]: one host transfer
+        result = [int(combine_hi_lo(int(h), int(l)))
+                  for h, l in zip(hilo[0], hilo[1])]
+        self._mark_phase("result_fetch")
+        return result
 
     def _bsi_arrays(self, idx, step):
         """Globally-sharded (planes [D,S,W], sign [S,W], exists [S,W]) for
@@ -1551,39 +1866,57 @@ class SpmdDataPlane:
         """BSI Sum over globally-sharded bit planes (reference per-shard
         algorithm: fragment.sum fragment.go:1068; the cross-node merge is
         the all-reduce XLA inserts over the [*, shards, words] arrays)."""
+        import jax
+
         from ..ops.bitplane import combine_hi_lo
 
         depth = int(step["depth"])
         planes, sign, exists = self._bsi_arrays(idx, step)
         sig = sig_from_wire(step["sig"])
         stacks, _ = self._leaf_arrays(idx, step)
+        self._mark_phase("stack_gather")
 
         fn = self._sum_fn(sig, len(stacks))
-        res = [np.asarray(r) for r in fn(planes, sign, exists, *stacks)]
+        out = fn(planes, sign, exists, *stacks)
+        self._mark_phase("device_enter")
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
+        res = [np.asarray(r) for r in out]
         p_hi, p_lo, n_hi, n_lo, c_hi, c_lo = res
         total = 0
         for i in range(depth):
             total += combine_hi_lo(p_hi[i], p_lo[i]) << i
             total -= combine_hi_lo(n_hi[i], n_lo[i]) << i
         self.steps_run += 1
-        return total, int(combine_hi_lo(c_hi, c_lo))
+        result = total, int(combine_hi_lo(c_hi, c_lo))
+        self._mark_phase("result_fetch")
+        return result
 
     def _run_minmax_step(self, idx, step):
         """Min/Max narrowing walk over globally-sharded planes; the
         replicated outputs (empty, use_neg, bits, count) decode on the
         coordinator (reference sign rules: fragment.go:1110-1227)."""
+        import jax
+
         from ..ops.bitplane import combine_hi_lo
 
         planes, sign, exists = self._bsi_arrays(idx, step)
         sig = sig_from_wire(step["sig"])
         stacks, _ = self._leaf_arrays(idx, step)
+        self._mark_phase("stack_gather")
 
         fn = self._minmax_fn(sig, len(stacks), bool(step["is_max"]))
-        empty, use_neg, bits, c_hi, c_lo = fn(planes, sign, exists, *stacks)
+        out = fn(planes, sign, exists, *stacks)
+        self._mark_phase("device_enter")
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
+        empty, use_neg, bits, c_hi, c_lo = out
         self.steps_run += 1
-        return (bool(empty), bool(use_neg),
-                [int(b) for b in np.asarray(bits)],
-                int(combine_hi_lo(c_hi, c_lo)))
+        result = (bool(empty), bool(use_neg),
+                  [int(b) for b in np.asarray(bits)],
+                  int(combine_hi_lo(c_hi, c_lo)))
+        self._mark_phase("result_fetch")
+        return result
 
     def _run_topn_step(self, idx, step):
         """Candidate-row counts over a globally-sharded [rows, shards,
@@ -1606,12 +1939,19 @@ class SpmdDataPlane:
 
         sig = sig_from_wire(step["sig"])
         stacks, _ = self._leaf_arrays(idx, step)
+        self._mark_phase("stack_gather")
 
         fn = self._topn_fn(sig, len(stacks))
-        hi, lo = fn(stack, *stacks)
+        out = fn(stack, *stacks)
+        self._mark_phase("device_enter")
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
+        hi, lo = out
         self.steps_run += 1
         totals = combine_hi_lo(hi, lo)
-        return [int(t) for t in totals]
+        result = [int(t) for t in totals]
+        self._mark_phase("result_fetch")
+        return result
 
     def _run_groupby_step(self, idx, step):
         """Cross-product counts over per-field globally-sharded [rows,
@@ -1640,12 +1980,19 @@ class SpmdDataPlane:
 
         sig = sig_from_wire(step["sig"])
         stacks, _ = self._leaf_arrays(idx, step)
+        self._mark_phase("stack_gather")
 
         fn = self._groupby_fn(tuple(lens), sig, len(stacks))
-        hi, lo = fn(*field_stacks, *stacks)
+        out = fn(*field_stacks, *stacks)
+        self._mark_phase("device_enter")
+        jax.block_until_ready(out)
+        self._mark_phase("psum")
+        hi, lo = out
         self.steps_run += 1
         totals = combine_hi_lo(hi, lo)
-        return [int(t) for t in totals]
+        result = [int(t) for t in totals]
+        self._mark_phase("result_fetch")
+        return result
 
     # -- compiled programs ----------------------------------------------------
 
@@ -1935,9 +2282,233 @@ class SpmdDataPlane:
                 "fallbacks": self.fallbacks,
             },
             "stream": stream,
+            "stream_gap_timeout": self.STREAM_GAP_TIMEOUT,
+            "observatory": self.observatory_stats(),
             "mesh_cache": self.mesh_cache.stats(),
             "http_data_plane_bytes": client_mod.data_plane_bytes(),
         }
+
+    # -- mesh observatory (read side) -----------------------------------------
+
+    def observatory_stats(self):
+        """Compact observatory counters (no ring contents): per-phase
+        totals, pipeline occupancy, gap + straggler tallies."""
+        with self._obs_lock:
+            totals = {p: {"count": c, "seconds": round(s, 6)}
+                      for p, (c, s) in self._phase_totals.items()}
+            ring = len(self._step_ring)
+        return {
+            "steps_recorded": ring,
+            "ring_size": self.STEP_RING_SIZE,
+            "phase_totals": totals,
+            "occupancy": self.occupancy(),
+            "straggler_flags": self.straggler_flags_total,
+        }
+
+    def occupancy(self):
+        """Step-stream pipeline occupancy: queue depth, how far this
+        node's execution lags the highest announced seq it has seen, and
+        cumulative time the runner spent blocked on sequence gaps."""
+        with self._stream_cond:
+            queued = len(self._stream_queue)
+            head = max(self._stream_queue) if self._stream_queue else None
+            nxt = self._stream_next
+        return {
+            "queue_depth": queued,
+            "seq_lag": max(0, (head or self.last_seq) - self.last_seq),
+            "stream_next": nxt,
+            "last_seq": self.last_seq,
+            "gap_onsets": self.gap_onsets,
+            "gap_stall_seconds": round(self.gap_stall_seconds, 6),
+        }
+
+    def register_gauges(self):
+        """Scrape-time pipeline-occupancy gauges on the process-global
+        stats client (called once from cli.cmd_server — NOT __init__, so
+        short-lived test planes never leak gauge closures)."""
+        from ..utils.stats import global_stats
+
+        if not hasattr(global_stats, "gauge_fn"):
+            return
+        global_stats.gauge_fn(
+            "spmd_stream_queue_depth",
+            lambda: len(self._stream_queue))
+        global_stats.gauge_fn(
+            "spmd_stream_seq_lag",
+            lambda: max(0, (max(self._stream_queue)
+                            if self._stream_queue else self.last_seq)
+                        - self.last_seq))
+        global_stats.gauge_fn(
+            "spmd_stream_gap_stall_seconds",
+            lambda: self.gap_stall_seconds)
+
+    def _local_node_id(self):
+        if self.cluster is not None:
+            return self.cluster.local_id
+        return "local"
+
+    def steps_local(self, seq=None, limit=None):
+        """This node's slice of the step timeline (what the coordinator
+        fans out for with ?local=true): recent step records with
+        per-phase walls, stamped with this node's wall clock so the
+        caller can skew-correct from the RPC envelope."""
+        with self._obs_lock:
+            steps = list(self._step_ring)
+        if seq is not None:
+            steps = [r for r in steps if r["seq"] == seq]
+        elif limit is not None and limit > 0:
+            steps = steps[-int(limit):]
+        return {
+            "node": self._local_node_id(),
+            "time": time.time(),
+            "steps": steps,
+            "occupancy": self.occupancy(),
+        }
+
+    def steps_timeline(self, seq=None, limit=32, local_only=False):
+        """GET /debug/spmd/steps[/{seq}]: the cross-node step timeline.
+
+        Fans out to mesh peers for their local slices (?local=true, the
+        PR-17 debug_trace pattern), estimates each peer's clock offset
+        from the RPC envelope (envelope_skew — same symmetric-delay
+        assumption as tracing.estimate_skew), shifts every peer's step
+        starts onto this node's clock, and merges per-seq into one
+        timeline with per-phase straggler attribution. Straggler flags
+        are edge-triggered: each (seq, node, phase) counts toward
+        spmd_step_straggler_total{node,phase} and fires the
+        spmd.straggler flightrec event exactly once, no matter how often
+        the timeline is scraped."""
+        local_id = self._local_node_id()
+        payloads = {local_id: (self.steps_local(seq=seq, limit=limit),
+                               0.0)}
+        if not local_only and self.cluster is not None \
+                and len(self.cluster.nodes) > 1:
+            from ..utils import tracing
+
+            with tracing.with_span(None):  # debug plumbing: never trace
+                for node in self.cluster.peers():
+                    try:
+                        client = self.client_factory(node.uri)
+                        t_send = time.time()
+                        remote = client.debug_spmd_steps(seq=seq,
+                                                         limit=limit)
+                        t_recv = time.time()
+                    except Exception:  # best-effort: peer down/old
+                        continue
+                    if not remote or remote.get("steps") is None:
+                        continue
+                    theta = envelope_skew(
+                        t_send, t_recv,
+                        float(remote.get("time") or t_recv))
+                    payloads[remote.get("node", node.id)] = (remote,
+                                                             theta)
+        merged = {}
+        for node, (payload, theta) in payloads.items():
+            for rec in payload.get("steps", []):
+                s = merged.setdefault(rec["seq"], {
+                    "seq": rec["seq"],
+                    "kind": rec.get("kind", "count"),
+                    "index": rec.get("index", ""),
+                    "peers": {},
+                })
+                if rec.get("trace") and not s.get("trace"):
+                    s["trace"] = rec["trace"]
+                s["peers"][node] = {
+                    # peer wall-clock start shifted onto OUR clock
+                    "start": round(rec["start"] - theta, 6),
+                    "wall_seconds": rec["wall_seconds"],
+                    "phases": rec.get("phases", {}),
+                    "ok": rec.get("ok", True),
+                }
+        steps = [merged[k] for k in sorted(merged)]
+        for s in steps:
+            s["stragglers"] = attribute_stragglers(
+                {n: p["phases"] for n, p in s["peers"].items()},
+                self.STRAGGLER_FACTOR, self.STRAGGLER_NOISE_FLOOR)
+            self._flag_stragglers(s["seq"], s["stragglers"])
+        return {
+            "node": local_id,
+            "skew_seconds": {n: round(th, 6)
+                             for n, (_, th) in payloads.items()},
+            "straggler_factor": self.STRAGGLER_FACTOR,
+            "noise_floor_seconds": self.STRAGGLER_NOISE_FLOOR,
+            "steps": steps,
+        }
+
+    def _flag_stragglers(self, seq, flags):
+        """Edge-triggered straggler accounting (see steps_timeline)."""
+        if not flags:
+            return
+        from ..utils import flightrec
+        from ..utils.stats import global_stats
+
+        for flag in flags:
+            key = (seq, flag["node"], flag["phase"])
+            with self._obs_lock:
+                if key in self._straggler_flags:
+                    continue
+                self._straggler_flags[key] = 1
+                while len(self._straggler_flags) \
+                        > self.STRAGGLER_FLAGS_MAX:
+                    self._straggler_flags.popitem(last=False)
+                self.straggler_flags_total += 1
+            try:
+                global_stats.count(
+                    "spmd_step_straggler_total",
+                    tags={"node": str(flag["node"]),
+                          "phase": flag["phase"]})
+            except Exception:  # noqa: BLE001
+                pass
+            flightrec.record(
+                "spmd.straggler", seq=seq, node=str(flag["node"]),
+                phase=flag["phase"], ratio=flag.get("ratio") or 0,
+                seconds=flag["seconds"])
+
+    def summary(self):
+        """Compact roll-up for /status?observability=true: serve mode,
+        step-lifecycle counters, stream health, mesh-cache stats."""
+        occ = self.occupancy()
+        return {
+            "serve_mode": self.serve_mode,
+            "steps": {
+                "announced": self.steps_announced,
+                "entered": self.steps_entered,
+                "exited": self.steps_exited,
+                "last_seq": self.last_seq,
+                "batch": self.batch_steps,
+                "fused": self.fused_steps,
+            },
+            "queries": {
+                "batched": self.batched_queries,
+                "fused": self.fused_queries,
+                "forwarded": self.forwarded,
+                "fallbacks": self.fallbacks,
+            },
+            "stream": {
+                "errors": self.stream_errors,
+                "resyncs": self.stream_resyncs,
+                "queue_depth": occ["queue_depth"],
+                "seq_lag": occ["seq_lag"],
+                "gap_onsets": occ["gap_onsets"],
+                "gap_stall_seconds": occ["gap_stall_seconds"],
+            },
+            "straggler_flags": self.straggler_flags_total,
+            "mesh_cache": self.mesh_cache.stats(),
+        }
+
+    def incident_snapshot(self):
+        """Postmortem-bundle payload (utils/incident.py `spmd`
+        collector): the full debug snapshot plus this node's step ring
+        and, best-effort, the merged cross-node timeline — captured
+        while a collective_stall is still open, so the bundle shows
+        WHERE every peer was when the stream wedged."""
+        snap = self.debug_snapshot()
+        snap["steps_local"] = self.steps_local(limit=64)
+        try:
+            snap["timeline"] = self.steps_timeline(limit=16)
+        except Exception as e:  # noqa: BLE001 — never fail the bundle
+            snap["timeline_error"] = str(e)
+        return snap
 
 
 class SpmdBatchRunner:

@@ -577,6 +577,20 @@ class API:
         snap["enabled"] = True
         return snap
 
+    def spmd_debug_steps(self, seq=None, limit=32, local_only=False):
+        """GET /debug/spmd/steps[/{seq}] payload: the cross-node step
+        timeline (merged + skew-corrected + straggler-attributed), or
+        this node's local slice with ?local=true — the same fan-out
+        shape as debug_trace, so peers answer without recursing."""
+        if self.spmd is None:
+            return {"enabled": False}
+        if local_only:
+            out = self.spmd.steps_local(seq=seq, limit=limit)
+        else:
+            out = self.spmd.steps_timeline(seq=seq, limit=limit)
+        out["enabled"] = True
+        return out
+
     def spmd_set_mode(self, mode):
         """POST /debug/spmd {"serve_mode": ...}: runtime serve-mode
         switch (off|on|shadow|http — http forces the HTTP fan-out for
@@ -2030,6 +2044,11 @@ class API:
             out["admission"] = self._admission.summary()
         if self.oplog is not None:
             out["oplog"] = self.oplog.summary(compact=True)
+        if self.spmd is not None:
+            # the primary data plane's roll-up: serve mode, step
+            # lifecycle, stream health, mesh-cache stats (full views at
+            # /debug/spmd and /debug/spmd/steps)
+            out["spmd"] = self.spmd.summary()
         return out
 
     #: peer observability fetches must never wedge a /status response
@@ -2101,6 +2120,17 @@ class API:
                 out["admission"] = {k: adm.get(k) for k in
                                     ("state", "state_age_seconds",
                                      "calibration")}
+            sp = client.debug_spmd()
+            if sp.get("enabled"):
+                out["spmd"] = {
+                    "serve_mode": sp.get("serve_mode"),
+                    "steps": sp.get("steps"),
+                    "stream": sp.get("stream"),
+                    "mesh_cache": {
+                        k: (sp.get("mesh_cache") or {}).get(k)
+                        for k in ("hits", "misses", "entries",
+                                  "bytes")},
+                }
             return out
         except Exception as e:  # noqa: BLE001 — degraded, not fatal
             return {"error": str(e)}

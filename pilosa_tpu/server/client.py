@@ -433,6 +433,27 @@ class Client:
         the node runs without --incident-dir)."""
         return self._request("GET", "/debug/incidents")
 
+    def debug_spmd(self, deadline=2.0):
+        """The peer's SPMD-plane snapshot (serve mode, step-lifecycle
+        counters, stream + observatory state); {"enabled": False} when
+        the node runs without --spmd. Short deadline: the /status
+        observability roll-up must never wedge behind a stalled mesh."""
+        return self._request("GET", "/debug/spmd", deadline=deadline)
+
+    def debug_spmd_steps(self, seq=None, limit=None, deadline=2.0):
+        """The peer's LOCAL slice of the collective step timeline (step
+        ring + per-phase walls, stamped with the peer's wall clock). The
+        ?local=true form, same shape as debug_trace: the coordinator
+        skew-corrects from the RPC envelope and merges — the fan-out
+        cannot recurse."""
+        path = "/debug/spmd/steps"
+        if seq is not None:
+            path += f"/{int(seq)}"
+        path += "?local=true"
+        if limit is not None:
+            path += f"&limit={int(limit)}"
+        return self._request("GET", path, deadline=deadline)
+
     def export_csv(self, index, field, shard):
         data = self._request(
             "GET", f"/export?index={index}&field={field}&shard={shard}")

@@ -177,6 +177,10 @@ class PilosaHTTPServer:
             Route("GET", r"/debug/fusion", self._get_debug_fusion),
             Route("GET", r"/debug/spmd", self._get_debug_spmd),
             Route("POST", r"/debug/spmd", self._post_debug_spmd),
+            Route("GET", r"/debug/spmd/steps", self._get_debug_spmd_steps,
+                  args=("local", "limit")),
+            Route("GET", r"/debug/spmd/steps/(?P<seq>[0-9]+)",
+                  self._get_debug_spmd_step, args=("local", "limit")),
             Route("GET", r"/debug/slo", self._get_debug_slo),
             Route("GET", r"/debug/admission", self._get_debug_admission),
             Route("GET", r"/debug/oplog", self._get_debug_oplog),
@@ -869,6 +873,14 @@ class PilosaHTTPServer:
                          "(fingerprint / compile-ms / hits / last-hit "
                          "age), evictions, fuse-vs-interpret decision "
                          "counters",
+        "/debug/spmd": "SPMD mesh serving plane: serve mode, step "
+                       "lifecycle counters, stream + observatory state, "
+                       "mesh-resident cache (POST switches serve mode)",
+        "/debug/spmd/steps": "cross-node collective step timeline: "
+                             "per-peer phase walls skew-corrected and "
+                             "merged by seq with straggler attribution; "
+                             "/debug/spmd/steps/{seq} for one step, "
+                             "?local=true for this node's raw ring",
         "/debug/slo": "SLO objectives and multi-window error-budget "
                       "burn rates",
         "/debug/admission": "admission controller: degradation-ladder "
@@ -950,6 +962,24 @@ class PilosaHTTPServer:
         same cluster)."""
         body = req.json() or {}
         return self.api.spmd_set_mode(body.get("serve_mode"))
+
+    def _get_debug_spmd_steps(self, req, seq=None):
+        """Cross-node collective step timeline: every peer's per-phase
+        step walls skew-corrected onto this node's clock and merged by
+        seq, with per-phase straggler attribution. ?local=true returns
+        this node's raw slice (what the coordinator fans out for — the
+        same non-recursing shape as /debug/traces/{id})."""
+        local_only = (self._q1(req, "local", "") or "").lower() \
+            in ("1", "true", "yes")
+        limit = self._q1(req, "limit", None)
+        limit = int(limit) if limit is not None else 32
+        return self.api.spmd_debug_steps(seq=seq, limit=limit,
+                                         local_only=local_only)
+
+    def _get_debug_spmd_step(self, req):
+        """One step of the cross-node timeline by sequence number."""
+        return self._get_debug_spmd_steps(
+            req, seq=int(req.params["seq"]))
 
     def _get_debug_slo(self, req):
         """SLO objectives with fast/slow-window error-budget burn rates

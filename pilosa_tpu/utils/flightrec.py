@@ -44,10 +44,16 @@ Event taxonomy (kind prefixes; see docs/architecture.md):
   spmd.*       collective step lifecycle (cluster/spmd.py): step_announce
                when the coordinator assigns a step-seq and fans it out,
                step_enter/step_exit on EVERY process around the collective
-               program (tags: seq, ok), stream_resync when a step-stream
-               gap times out and the runner skips ahead. The enter/exit
-               pairing is what lets bench.py distinguish "peer never
-               entered the collective" from "collective hung".
+               program (tags: seq, ok), stream_gap at the ONSET of a
+               step-stream sequence gap (later steps queued, expected seq
+               missing — previously invisible until resync), stream_resync
+               when the gap times out and the runner skips ahead, and
+               straggler (edge-triggered, coordinator-side) when one
+               node's per-phase step wall exceeds the peer median by the
+               configured factor in the merged /debug/spmd/steps
+               timeline. The enter/exit pairing is what lets bench.py
+               distinguish "peer never entered the collective" from
+               "collective hung".
   fusion.compile  whole-plan (and mesh collective) program compiles with
                   wall time; mesh programs carry a `mesh` tag
 """
@@ -323,9 +329,14 @@ class Watchdog:
         from . import incident as _incident
 
         # evt's "kind" is the stalled OP's kind — rename so it cannot
-        # collide with the trigger kind parameter
+        # collide with the trigger kind parameter. A wedged collective
+        # (an spmd.* op: entered but never exited past its deadline) is
+        # its own incident class: collective_stall bundles additionally
+        # capture every peer's step ring via the spmd collector.
+        trigger = "collective_stall" if op.kind.startswith("spmd.") \
+            else "watchdog_stall"
         _incident.maybe_trigger(
-            "watchdog_stall",
+            trigger,
             **{("op" if k == "kind" else k): v for k, v in evt.items()})
 
     def open_ops(self, now=None):

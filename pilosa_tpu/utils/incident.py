@@ -9,6 +9,10 @@ the existing EDGE signals
 
     devhealth_down    device-link prober transitions to DOWN
     watchdog_stall    an in-flight op ran past its watchdog deadline
+    collective_stall  the SPMD plane wedged: a step-stream sequence gap
+                      opened (cluster/spmd.py _stream_loop, at ONSET) or
+                      a collective step ran past its watchdog deadline
+                      (flightrec Watchdog, spmd.* op kinds)
     slo_burn          error-budget burn alert fired (both windows)
     deadline_storm    >= N deadline-expired rejections inside a window
     fatal_signal      SIGTERM / crash-handler chain
@@ -333,10 +337,18 @@ def _default_collectors():
         from . import tracing
         return tracing.trace_index().stats()
 
+    def spmd():
+        # the SPMD plane's observatory: step ring, per-phase tables, and
+        # (best-effort) the cross-node timeline — in EVERY bundle, so a
+        # devhealth_down or watchdog_stall autopsy also shows where the
+        # collective plane was, not just the collective_stall trigger
+        from ..cluster import spmd as spmd_mod
+        return spmd_mod.observatory_snapshot()
+
     return {"device": device, "dispatch": dispatch,
             "workload": workload_, "heat": heat, "slo": slo,
             "fusion": fusion, "queries": queries,
-            "open_ops": open_ops, "traces": traces}
+            "open_ops": open_ops, "traces": traces, "spmd": spmd}
 
 
 # -- module singleton (the flightrec/devhealth pattern) ----------------------

@@ -25,6 +25,7 @@ import time
 
 import pytest
 
+from pilosa_tpu.cluster.spmd import STEP_PHASES
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 from .harness import SpmdMeshCluster
@@ -243,3 +244,45 @@ def test_stream_lifecycle_counters_consistent(cluster):
     coord = cluster.debug(cluster.coord)
     assert coord["steps"]["announced"] > 0
     assert coord["steps"]["last_seq"] > 0
+
+
+def test_merged_timeline_both_peers_phase_sums_no_false_stragglers(cluster):
+    """PR-19 acceptance on the live mesh: GET /debug/spmd/steps returns
+    a skew-corrected per-peer timeline where BOTH processes report every
+    step, each peer's phases sum to its step wall (≤5% residual), and a
+    warm same-host mesh flags zero stragglers (the 25ms noise floor
+    swallows scheduler jitter)."""
+    coord = cluster.clients[cluster.coord]
+    cluster.set_mode("on")
+    # warm the collective kinds first so no one-sided compile wall lands
+    # in the sampled steps and masquerades as a straggler
+    warm = ("Count(Row(f=1))", "Sum(field=v)", "TopN(f, n=2)")
+    for q in warm:
+        coord.query("m", q)
+    marker = cluster.debug(cluster.coord)["steps"]["last_seq"]
+    for q in warm:
+        coord.query("m", q)
+
+    tl = coord._request("GET", "/debug/spmd/steps?limit=64")
+    assert tl["enabled"] is True
+    assert len(tl["skew_seconds"]) == 2  # one envelope theta per node
+    fresh = [s for s in tl["steps"] if s["seq"] > marker]
+    assert len(fresh) >= len(warm), tl["steps"]
+    for s in fresh:
+        assert len(s["peers"]) == 2, s
+        for peer in s["peers"].values():
+            wall = peer["wall_seconds"]
+            assert set(peer["phases"]) <= set(STEP_PHASES)
+            residual = abs(sum(peer["phases"].values()) - wall)
+            assert residual <= 0.05 * wall + 1e-5, (residual, peer)
+        # same-host processes: skew-corrected starts must line up far
+        # tighter than uncorrected wall clocks ever need to
+        starts = [p["start"] for p in s["peers"].values()]
+        assert max(starts) - min(starts) < 1.0, s
+        assert s["stragglers"] == [], s
+
+    # the single-seq endpoint returns exactly that step, both peers
+    seq = fresh[-1]["seq"]
+    one = coord._request("GET", "/debug/spmd/steps/%d" % seq)
+    assert [x["seq"] for x in one["steps"]] == [seq]
+    assert len(one["steps"][0]["peers"]) == 2
